@@ -1,0 +1,32 @@
+"""tensorflowonspark_tpu — a TPU-native distributed training/inference framework.
+
+A brand-new framework with the capabilities of TensorFlowOnSpark
+(reference: hopshadoop/TensorFlowOnSpark), redesigned TPU-first:
+
+- Cluster lifecycle API (``TPUCluster.run/train/inference/shutdown``),
+  replacing ``tensorflowonspark/TFCluster.py``.
+- Per-host node runtime handing out TPU mesh coordinates instead of
+  ``CUDA_VISIBLE_DEVICES``, replacing ``tensorflowonspark/TFSparkNode.py``.
+- Streaming data plane (``DataFeed``) with end-of-partition semantics,
+  replacing ``tensorflowonspark/TFNode.py`` + ``TFManager.py`` queues.
+- TCP coordinator/rendezvous with barrier/reduce/heartbeat, replacing
+  ``tensorflowonspark/reservation.py``.
+- Sync SPMD data parallelism via ``jax.jit`` + shardings over a
+  ``jax.sharding.Mesh`` (XLA collectives over ICI), replacing the
+  ParameterServer / MultiWorkerMirrored (gRPC+NCCL) path.
+- ML pipeline layer (``TPUEstimator``/``TPUModel``), replacing
+  ``tensorflowonspark/pipeline.py``.
+- TFRecord + tf.train.Example codec without a TensorFlow dependency,
+  replacing ``tensorflowonspark/dfutil.py`` + the tensorflow-hadoop jar.
+
+See SURVEY.md for the reference layer map this package mirrors.
+"""
+
+__version__ = "0.1.0"
+
+from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
+from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
+from tensorflowonspark_tpu.data import PartitionedDataset  # noqa: F401
+
+# Drop-in style aliases for users coming from TensorFlowOnSpark.
+TFCluster = TPUCluster

@@ -1,0 +1,301 @@
+"""Driver-side cluster lifecycle API — the ``TFCluster`` replacement.
+
+Reference (``tensorflowonspark/TFCluster.py``): ``run()`` ``:~270-420`` builds
+the role template, starts the reservation server, launches node closures on
+executors, and returns a cluster handle with ``train`` ``:~70-130``,
+``inference`` ``:~130-170``, ``shutdown`` ``:~170-240`` and
+``tensorboard_url`` ``:~240-260``; ``InputMode`` at ``:~40``.
+
+TPU-native deltas (BASELINE.json:5, SURVEY.md §2.3):
+- **No parameter servers.** ``num_ps`` is gone; async PS data parallelism is
+  replaced by sync SPMD data parallelism (XLA all-reduce over ICI inside the
+  jitted train step).  Roles are chief/worker/evaluator only.
+- **Launcher abstraction** instead of Spark: ``LocalLauncher`` (default) or a
+  TPU-pod launcher place node processes; partitions stream over the data
+  plane (``dataserver.py``) rather than Spark feed tasks.
+- ``InputMode.DIRECT`` (framework reads files itself — the reference's
+  ``InputMode.TENSORFLOW``) vs ``InputMode.STREAMING`` (driver streams
+  partitions — the reference's ``InputMode.SPARK``).  Aliases with the
+  reference names are provided.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import secrets
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from tensorflowonspark_tpu.coordinator import CoordinatorServer
+from tensorflowonspark_tpu.data import as_partitioned
+from tensorflowonspark_tpu.dataserver import DataClient
+from tensorflowonspark_tpu.launcher import LocalLauncher
+from tensorflowonspark_tpu.node import NodeConfig
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode(enum.Enum):
+    """Reference ``TFCluster.InputMode`` (``TFCluster.py:~40``)."""
+
+    DIRECT = 0      # framework reads files itself (reference: TENSORFLOW)
+    STREAMING = 1   # driver streams partitions into node feeds (reference: SPARK)
+
+    # Drop-in aliases for TensorFlowOnSpark users.
+    TENSORFLOW = 0
+    SPARK = 1
+
+
+def _build_roles(num_executors: int, master_node: str | None, eval_node: bool) -> list[tuple[str, int]]:
+    """Role template (reference ``TFCluster.py:~290-330``, minus ``ps``)."""
+    roles: list[tuple[str, int]] = []
+    chief_name = master_node or "chief"
+    roles.append((chief_name, 0))
+    num_workers = num_executors - 1 - (1 if eval_node else 0)
+    if num_workers < 0:
+        raise ValueError("num_executors too small for the requested roles")
+    roles.extend(("worker", i) for i in range(num_workers))
+    if eval_node:
+        roles.append(("evaluator", 0))
+    return roles
+
+
+class TPUCluster:
+    """Handle to a running cluster (reference ``class TFCluster``)."""
+
+    def __init__(
+        self,
+        coordinator: CoordinatorServer,
+        launcher: LocalLauncher,
+        cluster_info: list[dict],
+        authkey: bytes,
+        input_mode: InputMode,
+        queues: Sequence[str],
+        feed_timeout: float,
+    ):
+        self.coordinator = coordinator
+        self.launcher = launcher
+        self.cluster_info = cluster_info
+        self.authkey = authkey
+        self.input_mode = input_mode
+        self.queues = queues
+        self.input_qnames = [q for q in queues if q not in ("output", "error")]
+        self.feed_timeout = feed_timeout
+        self._clients: dict[int, DataClient] = {}
+        self._shutdown_done = False
+        # Feedable nodes: everything except the evaluator (the reference also
+        # excluded ps nodes; we have none).
+        self._feed_ids = [m["executor_id"] for m in cluster_info if m["job_name"] != "evaluator"]
+
+    # -- data-plane connections ---------------------------------------------
+
+    def _client(self, executor_id: int) -> DataClient:
+        if executor_id not in self._clients:
+            meta = self.cluster_info[executor_id]
+            self._clients[executor_id] = DataClient(meta["host"], meta["data_port"], self.authkey)
+        return self._clients[executor_id]
+
+    # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------
+
+    def train(self, data: Any, num_epochs: int = 1, qname: str = "input") -> None:
+        """Stream partitions into the worker feeds (InputMode.STREAMING only).
+
+        Partition *i* goes to feedable node ``i % W`` — the same round-robin
+        partition placement Spark gave the reference.  Blocks until all
+        partitions are consumed (or nodes report 'terminating').
+        """
+        if self.input_mode != InputMode.STREAMING:
+            raise RuntimeError("train(data) requires InputMode.STREAMING (reference: InputMode.SPARK)")
+        dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+        errors: list[Exception] = []
+
+        def _feed_worker(worker_pos: int, executor_id: int) -> None:
+            try:
+                client = self._client(executor_id)
+                for epoch in range(num_epochs):
+                    for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
+                        state = client.feed_partition(dataset.iter_partition(p), qname)
+                        if state == "terminating":
+                            logger.info("node %d terminating; dropping remaining feed", executor_id)
+                            return
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=_feed_worker, args=(pos, eid), name=f"feed-{eid}")
+            for pos, eid in enumerate(self._feed_ids)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._raise_node_errors()
+        if errors:
+            raise RuntimeError(f"feeding failed: {errors[0]}") from errors[0]
+
+    # -- inference (reference TFCluster.inference :~130-170, §3.3) -----------
+
+    def inference(self, data: Any, qname_in: str = "input", qname_out: str = "output") -> list:
+        """Round-trip partitions through the nodes; ordered, exactly-count.
+
+        Returns the flattened results in partition order — the invariant the
+        reference's output RDD preserved (SURVEY.md §3.3).
+        """
+        if self.input_mode != InputMode.STREAMING:
+            raise RuntimeError(
+                "inference(data) requires InputMode.STREAMING (reference: InputMode.SPARK); "
+                "DIRECT-mode map_funs read files themselves and never consume the feed"
+            )
+        dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+        results: list[list | None] = [None] * dataset.num_partitions
+        errors: list[Exception] = []
+
+        def _infer_worker(worker_pos: int, executor_id: int) -> None:
+            try:
+                client = self._client(executor_id)
+                for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
+                    results[p] = client.infer_partition(list(dataset.iter_partition(p)), qname_in, qname_out)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=_infer_worker, args=(pos, eid), name=f"infer-{eid}")
+            for pos, eid in enumerate(self._feed_ids)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._raise_node_errors()
+        if errors:
+            raise RuntimeError(f"inference failed: {errors[0]}") from errors[0]
+        return [item for part in results for item in (part or [])]
+
+    # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
+
+    def shutdown(self, grace_secs: float = 0.0, timeout: float = 120.0) -> None:
+        """Send end-of-feed, join node processes, propagate node errors."""
+        if self._shutdown_done:
+            return
+        try:
+            # DIRECT-mode map_funs never consume the feed; EOF would just open
+            # pointless connections to nodes that may already have exited.
+            if self.input_mode == InputMode.STREAMING:
+                for executor_id in self._feed_ids:
+                    for qname in self.input_qnames:
+                        try:
+                            self._client(executor_id).send_eof(qname)
+                        except Exception:
+                            logger.warning("could not send EOF to node %d queue %r",
+                                           executor_id, qname, exc_info=True)
+            if grace_secs:
+                time.sleep(grace_secs)
+            # Politely wait for map_funs to finish; only then escalate.  The
+            # stop flag breaks in-flight barriers/reduces, so raising it early
+            # would abort healthy nodes mid-collective.
+            forced = False
+            if not self.launcher.join(timeout):
+                alive = self.launcher.alive()
+                logger.warning("nodes %s still running after %.0fs; signalling stop", alive, timeout)
+                self.coordinator.signal_stop()  # heartbeats tell stragglers to stop
+                if not self.launcher.join(15.0):
+                    forced = True
+                    logger.warning("nodes %s ignored stop; terminating", self.launcher.alive())
+                    self.launcher.terminate()
+            for c in self._clients.values():
+                c.close()
+            self._raise_node_errors()
+            exit_codes = [p.exitcode for p in self.launcher.processes]
+            if any(code is None for code in exit_codes):
+                # survived SIGTERM+SIGKILL: a live zombie may still hold chips
+                raise RuntimeError(f"node processes could not be killed (exit codes {exit_codes}); "
+                                   f"zombie processes may be holding TPU devices")
+            if forced:
+                raise RuntimeError(f"node processes had to be force-terminated (exit codes {exit_codes})")
+            if any(code != 0 for code in exit_codes):
+                raise RuntimeError(f"node processes exited abnormally: {exit_codes}")
+        finally:
+            self._shutdown_done = True
+            self.coordinator.stop()
+
+    def _raise_node_errors(self) -> None:
+        errs = self.coordinator.errors()
+        if errs:
+            tb = errs[0].get("traceback", "")
+            raise RuntimeError(
+                f"node {errs[0].get('executor_id')} failed "
+                f"({len(errs)} node error(s) total):\n{tb}"
+            )
+
+    # -- observability (reference TFCluster.tensorboard_url :~240-260) -------
+
+    def tensorboard_url(self) -> str | None:
+        for meta in self.coordinator.cluster_info():
+            if "tb_url" in meta:
+                return meta["tb_url"]
+        return None
+
+
+def run(
+    map_fun: Callable,
+    tf_args: Any = None,
+    num_executors: int = 1,
+    input_mode: InputMode = InputMode.DIRECT,
+    master_node: str | None = None,
+    eval_node: bool = False,
+    tensorboard: bool = False,
+    log_dir: str = "",
+    default_fs: str = "",
+    queues: Sequence[str] = ("input", "output", "error"),
+    queue_capacity: int = 1024,
+    feed_timeout: float = 600.0,
+    reservation_timeout: float = 120.0,
+    launcher: LocalLauncher | None = None,
+    env: dict[str, str] | None = None,
+    jax_distributed: bool = False,
+) -> TPUCluster:
+    """Start a cluster (reference ``TFCluster.run`` ``:~270-420``).
+
+    No ``sc`` (no Spark), no ``num_ps`` (sync SPMD replaces parameter
+    servers), no ``driver_ps_nodes``/``release_port`` (their race classes are
+    designed out — SURVEY.md §5.2).
+    """
+    roles = _build_roles(num_executors, master_node, eval_node)
+    coordinator = CoordinatorServer(num_executors, roles)
+    addr = coordinator.start()
+    authkey = secrets.token_bytes(16)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    configs = [
+        NodeConfig(
+            coordinator_addr=addr,
+            authkey=authkey,
+            map_fun=map_fun,
+            tf_args=tf_args,
+            queues=tuple(queues),
+            input_qnames=tuple(q for q in queues if q not in ("output", "error")),
+            queue_capacity=queue_capacity,
+            feed_timeout=feed_timeout,
+            reservation_timeout=reservation_timeout,
+            default_fs=default_fs,
+            log_dir=log_dir,
+            tensorboard=tensorboard,
+            jax_distributed=jax_distributed,
+            env=dict(env or {}),
+        )
+        for _ in range(num_executors)
+    ]
+    launcher = launcher or LocalLauncher()
+    launcher.launch(configs, log_dir or None)
+    try:
+        cluster_info = coordinator.await_registrations(reservation_timeout)
+    except TimeoutError:
+        launcher.terminate()
+        coordinator.stop()
+        raise
+    logger.info("cluster up: %s", [(m["executor_id"], m["job_name"]) for m in cluster_info])
+    return TPUCluster(coordinator, launcher, cluster_info, authkey, input_mode, queues, feed_timeout)

@@ -1,0 +1,367 @@
+"""Cluster rendezvous / control-plane coordinator.
+
+TPU-native replacement for ``tensorflowonspark/reservation.py`` (reference:
+``MessageSocket`` 4-byte length framing ``:~20-60``; REG/QUERY/QINFO/STOP
+``:~100-200``; ``Server.await_reservations`` ``:~120-160``).  Differences by
+design (SURVEY.md §5.2, §5.8):
+
+- **Race-free identity**: the server *assigns* ``executor_id`` and the job
+  role (chief/worker/evaluator) at registration, instead of deriving it from a
+  Spark partition id — this is the ``CUDA_VISIBLE_DEVICES``-handout replaced
+  by mesh-coordinate handout (BASELINE.json:5).
+- **Barrier + reduce primitives**: sync SPMD needs *global* agreement (e.g.
+  the end-of-data consensus of SURVEY.md §7.3-1), which the reference's async
+  PS design never needed.  ``reduce`` implements an all-reduce over the
+  control plane (DCN), not the tensor plane.
+- **Heartbeats**: the reference relied on Spark noticing dead executors;
+  with no Spark layer the coordinator tracks liveness itself (SURVEY.md §5.3).
+- **JSON framing, not pickle**: the control plane carries only small metadata
+  dicts; JSON avoids arbitrary-object deserialization on the driver.
+
+The *tensor* plane never touches this module: device-to-device traffic is XLA
+collectives over ICI emitted by jit-compiled SPMD programs (SURVEY.md §5.8-2).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    if n > _MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+class _Rendezvous:
+    """One barrier/reduce *generation* shared by ``count`` participants.
+
+    Lifecycle: participants join until ``count`` values arrive, the last one
+    computes the result and marks ``done`` (popping the registry entry, so a
+    subsequent same-named call starts a fresh generation while waiters still
+    hold this object).  A participant that times out marks the generation
+    ``aborted`` and pops it, so retries never observe stale values.
+    """
+
+    def __init__(self, count: int):
+        self.count = count
+        self.cond = threading.Condition()
+        self.values: list[Any] = []
+        self.result: Any = None
+        self.done = False
+        self.aborted = False
+
+
+def _reduce(kind: str, values: list[Any]) -> Any:
+    if kind == "any":
+        return any(values)
+    if kind == "all":
+        return all(values)
+    if kind == "sum":
+        return sum(values)
+    if kind == "min":
+        return min(values)
+    if kind == "max":
+        return max(values)
+    if kind == "gather":
+        return values
+    raise ValueError(f"unknown reduce kind: {kind}")
+
+
+class CoordinatorServer:
+    """Driver-side rendezvous server for ``expected`` node processes.
+
+    Mirrors ``reservation.Server`` but also assigns identities/roles and
+    provides barrier/reduce/heartbeat/error channels.
+    """
+
+    def __init__(self, expected: int, roles: list[tuple[str, int]] | None = None):
+        if roles is not None and len(roles) != expected:
+            raise ValueError("roles must have one entry per expected node")
+        self.expected = expected
+        # role for executor i; default: executor 0 is chief, rest workers.
+        self.roles = roles or [("chief", 0)] + [("worker", i) for i in range(1, expected)]
+        self._lock = threading.Lock()
+        self._nodes: list[dict] = []
+        self._complete = threading.Event()
+        self._stop_flag = threading.Event()
+        self._errors: list[dict] = []
+        self._rdv: dict[str, _Rendezvous] = {}
+        self._last_seen: dict[int, float] = {}
+        self._server: socketserver.ThreadingTCPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.address: tuple[str, int] | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1") -> tuple[str, int]:
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one connection, many requests
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        resp = outer._dispatch(msg)
+                        _send_msg(self.request, resp)
+                        if msg.get("op") in ("stop", "bye"):
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, 0), Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="coordinator")
+        self._thread.start()
+        logger.info("coordinator listening on %s:%d (expecting %d nodes)", *self.address, self.expected)
+        return self.address
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- driver-side queries -------------------------------------------------
+
+    def await_registrations(self, timeout: float | None = None) -> list[dict]:
+        """Block until all nodes registered (``Server.await_reservations``)."""
+        if not self._complete.wait(timeout):
+            with self._lock:
+                n = len(self._nodes)
+            raise TimeoutError(f"only {n}/{self.expected} nodes registered within {timeout}s")
+        return self.cluster_info()
+
+    def cluster_info(self) -> list[dict]:
+        with self._lock:
+            return [dict(m) for m in sorted(self._nodes, key=lambda m: m["executor_id"])]
+
+    def errors(self) -> list[dict]:
+        with self._lock:
+            return list(self._errors)
+
+    def dead_nodes(self, heartbeat_timeout: float) -> list[int]:
+        now = time.monotonic()
+        with self._lock:
+            return [i for i, t in self._last_seen.items() if now - t > heartbeat_timeout]
+
+    def signal_stop(self) -> None:
+        """Make subsequent heartbeats tell nodes to stop (zombie-free teardown)."""
+        self._stop_flag.set()
+
+    # -- request dispatch ----------------------------------------------------
+
+    def _dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        try:
+            if op == "register":
+                return self._op_register(msg)
+            if op == "query":
+                return {"ok": True, "complete": self._complete.is_set(), "count": len(self._nodes)}
+            if op == "cluster_info":
+                if not self._complete.is_set():
+                    return {"ok": False, "error": "cluster incomplete"}
+                return {"ok": True, "nodes": self.cluster_info()}
+            if op == "barrier":
+                msg = dict(msg, kind="all", value=True)
+                return self._op_reduce(msg)
+            if op == "reduce":
+                return self._op_reduce(msg)
+            if op == "update_meta":
+                with self._lock:
+                    for m in self._nodes:
+                        if m["executor_id"] == msg["executor_id"]:
+                            m.update(msg.get("patch") or {})
+                return {"ok": True}
+            if op == "heartbeat":
+                with self._lock:
+                    self._last_seen[msg["executor_id"]] = time.monotonic()
+                return {"ok": True, "stop": self._stop_flag.is_set()}
+            if op == "error":
+                with self._lock:
+                    self._errors.append({"executor_id": msg.get("executor_id"), "traceback": msg.get("traceback", "")})
+                logger.error("node %s reported error:\n%s", msg.get("executor_id"), msg.get("traceback", ""))
+                return {"ok": True}
+            if op == "stop":
+                self._stop_flag.set()
+                return {"ok": True}
+            if op == "bye":
+                return {"ok": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except Exception as e:  # keep the server alive on handler bugs
+            logger.exception("coordinator op %s failed", op)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _op_register(self, msg: dict) -> dict:
+        meta = dict(msg.get("meta") or {})
+        with self._lock:
+            if self._complete.is_set():
+                return {"ok": False, "error": "cluster already complete"}
+            executor_id = len(self._nodes)
+            job_name, task_index = self.roles[executor_id]
+            meta.update(executor_id=executor_id, job_name=job_name, task_index=task_index)
+            self._nodes.append(meta)
+            self._last_seen[executor_id] = time.monotonic()
+            if len(self._nodes) == self.expected:
+                self._complete.set()
+        logger.info("registered node %d as %s:%d (%s)", executor_id, job_name, task_index, meta.get("host"))
+        return {"ok": True, "executor_id": executor_id, "job_name": job_name,
+                "task_index": task_index, "expected": self.expected}
+
+    def _op_reduce(self, msg: dict) -> dict:
+        name, kind, value = msg["name"], msg.get("kind", "gather"), msg.get("value")
+        timeout = msg.get("timeout", 300.0)
+        # Participant count may be a subgroup (e.g. feedable nodes excluding
+        # the evaluator); every participant must pass the same count.
+        count = int(msg.get("count") or self.expected)
+        with self._lock:
+            rdv = self._rdv.get(name)
+            # done/aborted generations are popped by whoever finished them,
+            # but guard anyway: never join a finished generation.
+            if rdv is None or rdv.done or rdv.aborted:
+                rdv = self._rdv[name] = _Rendezvous(count)
+            elif rdv.count != count:
+                return {"ok": False, "error": f"reduce {name!r}: conflicting participant counts "
+                                              f"({rdv.count} vs {count})"}
+        with rdv.cond:
+            if rdv.done or rdv.aborted:
+                # generation finished between registry lookup and here; the
+                # caller raced a completed round — treat as a fresh failure
+                # rather than returning another round's result.
+                return {"ok": False, "error": f"barrier/reduce {name!r} generation closed; retry"}
+            rdv.values.append(value)
+            if len(rdv.values) == rdv.count:
+                rdv.result = _reduce(kind, rdv.values)
+                rdv.done = True
+                with self._lock:
+                    if self._rdv.get(name) is rdv:
+                        del self._rdv[name]
+                rdv.cond.notify_all()
+            else:
+                deadline = time.monotonic() + timeout
+                while not (rdv.done or rdv.aborted):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop_flag.is_set():
+                        rdv.aborted = True
+                        with self._lock:
+                            if self._rdv.get(name) is rdv:
+                                del self._rdv[name]
+                        rdv.cond.notify_all()
+                        return {"ok": False, "error": f"barrier/reduce {name!r} timed out"}
+                    rdv.cond.wait(min(remaining, 0.5))
+                if rdv.aborted:
+                    return {"ok": False, "error": f"barrier/reduce {name!r} aborted (peer timed out)"}
+            return {"ok": True, "result": rdv.result}
+
+
+class CoordinatorClient:
+    """Node-side client (reference ``reservation.Client``), persistent socket."""
+
+    def __init__(self, address: tuple[str, int], connect_timeout: float = 30.0):
+        self.address = (address[0], int(address[1]))
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection(self.address, timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._gen = 0
+
+    def _call(self, msg: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, msg)
+            return _recv_msg(self._sock)
+
+    def _check(self, resp: dict) -> dict:
+        if not resp.get("ok"):
+            raise RuntimeError(f"coordinator error: {resp.get('error')}")
+        return resp
+
+    def register(self, meta: dict) -> dict:
+        """Register this node; returns assigned identity {executor_id, job_name, task_index}."""
+        return self._check(self._call({"op": "register", "meta": meta}))
+
+    def await_cluster(self, timeout: float | None = None, poll: float = 0.1) -> list[dict]:
+        """Poll QUERY until all nodes registered, then fetch cluster info (QINFO)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._check(self._call({"op": "query"}))["complete"]:
+                return self._check(self._call({"op": "cluster_info"}))["nodes"]
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("cluster did not complete in time")
+            time.sleep(poll)
+
+    def barrier(self, name: str, executor_id: int, timeout: float = 300.0,
+                count: int | None = None) -> None:
+        self._check(self._call({"op": "barrier", "name": name, "executor_id": executor_id,
+                                "timeout": timeout, "count": count}))
+
+    def reduce(self, name: str, value: Any, kind: str = "gather", timeout: float = 300.0,
+               count: int | None = None) -> Any:
+        """Control-plane all-reduce; ``count`` scopes it to a subgroup of nodes."""
+        return self._check(
+            self._call({"op": "reduce", "name": name, "value": value, "kind": kind,
+                        "timeout": timeout, "count": count})
+        )["result"]
+
+    def next_collective_name(self, prefix: str) -> str:
+        """Locally-generated unique name; callers must use it SPMD-consistently."""
+        self._gen += 1
+        return f"{prefix}:{self._gen}"
+
+    def update_meta(self, executor_id: int, patch: dict) -> None:
+        """Patch this node's registered metadata (e.g. tensorboard URL)."""
+        self._check(self._call({"op": "update_meta", "executor_id": executor_id, "patch": patch}))
+
+    def heartbeat(self, executor_id: int) -> bool:
+        """Send liveness ping; returns True if the driver asked us to stop."""
+        return bool(self._check(self._call({"op": "heartbeat", "executor_id": executor_id}))["stop"])
+
+    def report_error(self, executor_id: int, traceback_str: str) -> None:
+        self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})
+
+    def request_stop(self) -> None:
+        self._call({"op": "stop"})
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                _send_msg(self._sock, {"op": "bye"})
+                try:
+                    _recv_msg(self._sock)
+                except (ConnectionError, OSError, ValueError):
+                    pass
+        except OSError:
+            pass
+        finally:
+            self._sock.close()

@@ -1,0 +1,88 @@
+"""Partitioned dataset abstraction — the RDD stand-in.
+
+The reference's data plane is a Spark RDD/DataFrame (partitions delivered by
+Spark tasks, SURVEY.md §3.2).  This environment ships no Spark, and the
+framework is standalone by design (SURVEY.md §7): ``PartitionedDataset`` is
+the minimal partitioned collection the cluster API streams from.  Anything
+that can yield partitions (list of lists, list of generators, glob of files)
+adapts into it.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+
+class PartitionedDataset:
+    """An ordered list of lazily-evaluated partitions."""
+
+    def __init__(self, partition_fns: Sequence[Callable[[], Iterator[Any]]]):
+        self._partition_fns = list(partition_fns)
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def from_partitions(cls, partitions: Sequence[Iterable[Any]]) -> "PartitionedDataset":
+        """From concrete per-partition iterables (each re-iterable)."""
+        return cls([(lambda p=p: iter(p)) for p in partitions])
+
+    @classmethod
+    def from_iterable(cls, items: Iterable[Any], num_partitions: int) -> "PartitionedDataset":
+        """Split a flat sequence into ``num_partitions`` contiguous partitions."""
+        items = list(items)
+        n = len(items)
+        base, extra = divmod(n, num_partitions)
+        parts, start = [], 0
+        for i in range(num_partitions):
+            size = base + (1 if i < extra else 0)
+            parts.append(items[start : start + size])
+            start += size
+        return cls.from_partitions(parts)
+
+    @classmethod
+    def from_files(cls, pattern: str, reader: Callable[[str], Iterator[Any]]) -> "PartitionedDataset":
+        """One partition per file matching ``pattern`` (sorted), read lazily."""
+        files = sorted(_glob.glob(pattern))
+        if not files:
+            raise FileNotFoundError(f"no files match {pattern!r}")
+        return cls([(lambda f=f: reader(f)) for f in files])
+
+    # -- accessors -----------------------------------------------------------
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._partition_fns)
+
+    def iter_partition(self, index: int) -> Iterator[Any]:
+        return self._partition_fns[index]()
+
+    def __iter__(self) -> Iterator[Any]:
+        for i in range(self.num_partitions):
+            yield from self.iter_partition(i)
+
+    # -- transforms ----------------------------------------------------------
+
+    def map(self, fn: Callable[[Any], Any]) -> "PartitionedDataset":
+        return PartitionedDataset(
+            [(lambda pf=pf: (fn(x) for x in pf())) for pf in self._partition_fns]
+        )
+
+    def repartition(self, num_partitions: int) -> "PartitionedDataset":
+        return PartitionedDataset.from_iterable(list(self), num_partitions)
+
+
+def as_partitioned(data: Any, default_partitions: int = 1) -> PartitionedDataset:
+    """Coerce user input into a PartitionedDataset.
+
+    Accepts a PartitionedDataset, a sequence of *lists* (interpreted as
+    partitions), or a flat iterable of samples (split into
+    ``default_partitions``).  Samples that are themselves sequences should be
+    tuples, not lists, to avoid ambiguity with the partition form.
+    """
+    if isinstance(data, PartitionedDataset):
+        return data
+    data = list(data)
+    if data and all(isinstance(p, list) for p in data):
+        return PartitionedDataset.from_partitions(data)
+    return PartitionedDataset.from_iterable(data, default_partitions)

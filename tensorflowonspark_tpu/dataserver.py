@@ -1,0 +1,246 @@
+"""Per-node TCP data-plane server + driver-side client.
+
+Replaces the Spark RDD partition-delivery path of the reference
+(SURVEY.md §3.2/§3.3): where TFoS ran ``TFSparkNode.train``/``inference``
+closures inside pyspark workers that pushed items into ``TFManager`` remote
+queues (``TFSparkNode.py:~430-580``), here the driver streams partitions over
+a socket directly into the node's in-process ``FeedQueues``.  One hop, no
+manager proxy.
+
+Wire format: 8-byte length-framed pickle, **after** an HMAC-SHA256
+challenge-response handshake on the shared cluster ``authkey`` (mirroring the
+``multiprocessing`` authkey handshake the reference's manager queues used,
+``TFSparkNode.py:~80-130``).  No pickle bytes are deserialized before the
+peer has proven knowledge of the authkey — pickle is an arbitrary-code
+format, so authentication must precede deserialization.
+
+Invariants preserved:
+- feed backpressure: bounded queue put with ``feed_timeout`` raises upstream
+  (reference ``TFSparkNode.py:~460-490``);
+- 'terminating' state fast-drains remaining items so upstream feeders
+  unblock (reference ``TFNode.py:~400-430``);
+- inference returns **exactly count, ordered** results per partition
+  (reference invariant, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import logging
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Iterable, Sequence
+
+from tensorflowonspark_tpu.feeding import FeedQueues
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">Q")
+_NONCE_BYTES = 32
+
+
+def _hmac_handshake_server(sock: socket.socket, authkey: bytes) -> bool:
+    """Challenge the client; constant-time digest compare, no pickle involved."""
+    nonce = os.urandom(_NONCE_BYTES)
+    sock.sendall(nonce)
+    expected = hmac.new(authkey, nonce, hashlib.sha256).digest()
+    got = _recv_raw(sock, len(expected))
+    ok = hmac.compare_digest(expected, got)
+    sock.sendall(b"OK" if ok else b"NO")
+    return ok
+
+
+def _hmac_handshake_client(sock: socket.socket, authkey: bytes) -> bool:
+    nonce = _recv_raw(sock, _NONCE_BYTES)
+    sock.sendall(hmac.new(authkey, nonce, hashlib.sha256).digest())
+    return _recv_raw(sock, 2) == b"OK"
+
+
+def _recv_raw(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("data socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_raw(sock, 8))
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("data socket closed mid-frame")
+        buf.extend(chunk)
+    return pickle.loads(bytes(buf))
+
+
+class DataServer:
+    """Accepts driver feed/inference connections for one node process."""
+
+    def __init__(self, queues: FeedQueues, authkey: bytes, feed_timeout: float = 600.0):
+        self.queues = queues
+        self.authkey = authkey
+        self.feed_timeout = feed_timeout
+        from tensorflowonspark_tpu.utils.net import bound_socket
+
+        self._sock = bound_socket("")  # all interfaces: the driver may be remote
+        self.port: int = self._sock.getsockname()[1]
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="dataserver")
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- server internals ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            if not _hmac_handshake_server(conn, self.authkey):
+                logger.warning("rejected data-plane connection: bad authkey")
+                return
+            while True:
+                msg = _recv(conn)
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # surface handler errors to the driver
+                    logger.exception("dataserver op failed")
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                _send(conn, reply)
+                if msg[0] == "close":
+                    return
+        except (ConnectionError, OSError, EOFError):
+            return
+        finally:
+            conn.close()
+
+    def _handle(self, msg: tuple) -> tuple:
+        op = msg[0]
+        if op == "feed":
+            _, qname, items = msg
+            if self.queues.get("state") == "terminating":
+                return ("ok", "terminating")  # fast-drain: drop silently
+            q = self.queues.get_queue(qname)
+            for item in items:
+                try:
+                    q.put(item, block=True, timeout=self.feed_timeout)
+                except queue.Full:
+                    return ("err", f"feed timeout after {self.feed_timeout}s (consumer stalled?)")
+            return ("ok", "running")
+        if op == "end_partition":
+            self.queues.get_queue(msg[1]).put(EndPartition())
+            return ("ok",)
+        if op == "eof":
+            self.queues.get_queue(msg[1]).put(EndOfFeed())
+            return ("ok",)
+        if op == "infer":
+            _, qname_in, qname_out, items = msg
+            qi = self.queues.get_queue(qname_in)
+            qo = self.queues.get_queue(qname_out)
+            for item in items:
+                qi.put(item, block=True, timeout=self.feed_timeout)
+            qi.put(EndPartition())
+            results = []
+            for _ in range(len(items)):
+                try:
+                    results.append(qo.get(block=True, timeout=self.feed_timeout))
+                except queue.Empty:
+                    return ("err", f"inference produced {len(results)}/{len(items)} results "
+                                   f"before {self.feed_timeout}s timeout")
+            return ("ok", results)
+        if op == "close":
+            return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+
+class DataClient:
+    """Driver-side connection to one node's DataServer."""
+
+    def __init__(self, host: str, port: int, authkey: bytes, chunk_size: int = 512):
+        self.chunk_size = chunk_size
+        self._sock = socket.create_connection((host, port), timeout=60.0)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        if not _hmac_handshake_client(self._sock, authkey):
+            self._sock.close()
+            raise RuntimeError("data plane error: auth handshake failed")
+
+    def _check(self, reply: tuple) -> tuple:
+        if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
+            raise RuntimeError(f"data plane error: {reply[1] if len(reply) > 1 else reply!r}")
+        return reply
+
+    def _call(self, msg: tuple) -> tuple:
+        with self._lock:
+            _send(self._sock, msg)
+            return self._check(_recv(self._sock))
+
+    def feed_partition(self, items: Iterable[Any], qname: str = "input") -> str:
+        """Stream one partition; returns final node state ('running'/'terminating')."""
+        state = "running"
+        chunk: list = []
+        for item in items:
+            chunk.append(item)
+            if len(chunk) >= self.chunk_size:
+                state = self._call(("feed", qname, chunk))[1]
+                chunk = []
+                if state == "terminating":
+                    break  # consumer is done; drop the rest fast
+        if chunk and state != "terminating":
+            state = self._call(("feed", qname, chunk))[1]
+        self._call(("end_partition", qname))
+        return state
+
+    def infer_partition(self, items: Sequence[Any], qname_in: str = "input", qname_out: str = "output") -> list:
+        """Round-trip one partition; returns exactly-count ordered results."""
+        items = list(items)
+        results: list = []
+        for i in range(0, len(items), self.chunk_size):
+            chunk = items[i : i + self.chunk_size]
+            results.extend(self._call(("infer", qname_in, qname_out, chunk))[1])
+        return results
+
+    def send_eof(self, qname: str = "input") -> None:
+        self._call(("eof", qname))
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                _send(self._sock, ("close",))
+                try:
+                    _recv(self._sock)
+                except (ConnectionError, OSError, EOFError):
+                    pass
+        except OSError:
+            pass
+        finally:
+            self._sock.close()

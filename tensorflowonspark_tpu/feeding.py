@@ -1,0 +1,148 @@
+"""In-node streaming data plane: queues + the user-facing ``DataFeed``.
+
+Replaces the reference's ``TFManager`` (``tensorflowonspark/TFManager.py:~1-90``,
+multiprocessing manager queues) and ``TFNode.DataFeed``
+(``tensorflowonspark/TFNode.py:~250-430``).  Design delta (SURVEY.md §3.2):
+the reference forked the user ``map_fun`` into a background process because
+Spark needed its task slot back, paying a JVM→Python pickle plus a
+manager-proxy hop per sample.  Here the node process is ours, so ``map_fun``
+runs in the node's main thread and the feed is a plain in-process bounded
+queue filled by the ``DataServer`` socket thread — no cross-process hop on
+the hot path.
+
+Semantics preserved from the reference (these are load-bearing, see
+SURVEY.md §4 "queue/timeout edge cases"):
+
+- ``next_batch(n)`` returns *up to* ``n`` items; an ``EndPartition`` marker
+  ends the batch early (partial batch) so per-partition result counts line up
+  for inference (``TFNode.py:~280-340``).
+- An ``EndOfFeed`` sentinel sets ``done_feeding``; subsequent ``should_stop()``
+  is True.  Delta from the reference, which pushed a bare ``None`` from
+  ``TFSparkNode.shutdown``: here ``None`` is ordinary user data (samples with
+  optional fields must survive the feed) and only the explicit marker ends it.
+- ``terminate()`` sets state ``'terminating'`` and drains remaining input so
+  pending upstream feed calls unblock fast (``TFNode.py:~400-430``).
+- ``batch_results`` pushes to the output queue consumed by the inference
+  collector (``TFNode.py:~350-380``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Sequence
+
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker
+
+
+class FeedQueues:
+    """Named bounded queues + shared state dict for one node process.
+
+    Parity with ``TFManager.start(authkey, queues, mode)``; 'local' vs
+    'remote' modes are gone because there is no second Python process.
+    """
+
+    def __init__(self, qnames: Sequence[str] = ("input", "output", "error"), capacity: int = 1024):
+        self._queues: dict[str, queue.Queue] = {name: queue.Queue(maxsize=capacity) for name in qnames}
+        self._state: dict[str, Any] = {"state": "running"}
+        self._lock = threading.Lock()
+
+    def get_queue(self, qname: str) -> queue.Queue:
+        try:
+            return self._queues[qname]
+        except KeyError:
+            raise KeyError(f"unknown queue {qname!r}; have {sorted(self._queues)}") from None
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._state[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._state.get(key)
+
+
+class DataFeed:
+    """User-facing feed API inside ``map_fun`` (reference ``TFNode.DataFeed``).
+
+    ``input_mapping``: optional ordered mapping {column → name}.  When given,
+    ``next_batch`` returns ``{name: [values...]}`` columnar dicts (matching
+    the reference's tensor-name mapping behaviour); otherwise a flat list of
+    items.
+    """
+
+    def __init__(
+        self,
+        queues: FeedQueues,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict[str, str] | None = None,
+    ):
+        self.queues = queues
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.input_mapping = input_mapping
+        self.done_feeding = False
+
+    # -- consuming -----------------------------------------------------------
+
+    def next_batch(self, batch_size: int) -> list | dict:
+        """Pop up to ``batch_size`` items; partial on EndPartition/end-of-feed.
+
+        Reference hot loop ``TFNode.py:~280-340``.
+        """
+        q = self.queues.get_queue(self.qname_in)
+        batch: list = []
+        while len(batch) < batch_size:
+            item = q.get()
+            try:
+                if isinstance(item, EndPartition):
+                    if batch:
+                        break  # partial batch closes out the partition
+                    continue  # empty partition: keep waiting for real data
+                if isinstance(item, EndOfFeed):
+                    self.done_feeding = True
+                    break
+                if isinstance(item, Marker):
+                    continue
+                batch.append(item)
+            finally:
+                q.task_done()
+        if self.input_mapping:
+            return self._to_columns(batch)
+        return batch
+
+    def _to_columns(self, batch: list) -> dict:
+        names = list(self.input_mapping.values())
+        cols: dict[str, list] = {name: [] for name in names}
+        for item in batch:
+            values = item if isinstance(item, (list, tuple)) else (item,)
+            for name, v in zip(names, values):
+                cols[name].append(v)
+        return cols
+
+    # -- producing results (inference path) ----------------------------------
+
+    def batch_results(self, results: Iterable[Any]) -> None:
+        q = self.queues.get_queue(self.qname_out)
+        for r in results:
+            q.put(r)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def should_stop(self) -> bool:
+        return self.done_feeding
+
+    def terminate(self) -> None:
+        """Stop consuming: mark terminating and fast-drain remaining input."""
+        self.done_feeding = True
+        self.queues.set("state", "terminating")
+        q = self.queues.get_queue(self.qname_in)
+        while True:
+            try:
+                q.get(block=True, timeout=0.05)
+                q.task_done()
+            except queue.Empty:
+                return

@@ -1,0 +1,111 @@
+"""Process launchers — the Spark-role replacement for process placement.
+
+In the reference, Spark places one long-running task per executor
+(``sc.parallelize(...).foreachPartition(TFSparkNode.run(...))``,
+``TFCluster.py:~340-360``) and YARN/Hops provisions the hosts.  Here a
+launcher backend owns process placement (SURVEY.md §7.1-4):
+
+- ``LocalLauncher`` — N node processes on this machine (the test/dev path,
+  mirroring the reference's ``local-cluster[N,...]`` test trick, SURVEY.md §4).
+- ``TPUPodLauncher`` — placement across TPU-VM hosts of a pod slice; each
+  host runs one node process that owns that host's chips.  Requires an
+  out-of-band transport (ssh/GKE); scaffolded, not implemented in-repo.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+from typing import Sequence
+
+import cloudpickle
+
+from tensorflowonspark_tpu.node import NodeConfig
+
+
+def _child_entry(payload: bytes, log_path: str | None) -> None:
+    """Module-level child target (picklable under the 'spawn' start method)."""
+    if log_path:
+        f = open(log_path, "a", buffering=1)
+        os.dup2(f.fileno(), sys.stdout.fileno())
+        os.dup2(f.fileno(), sys.stderr.fileno())
+    config: NodeConfig = cloudpickle.loads(payload)
+    from tensorflowonspark_tpu.node import node_main
+
+    sys.exit(node_main(config))
+
+
+class LocalLauncher:
+    """Spawn node processes on the local host.
+
+    Uses the 'spawn' start method: forking a process after JAX/XLA has
+    initialized in the driver is unsafe, and spawn matches how real TPU-VM
+    hosts start fresh Python processes.  ``map_fun`` travels via cloudpickle
+    (the same closure-shipping contract Spark gave the reference).
+    """
+
+    def __init__(self, env: dict[str, str] | None = None):
+        self.env = dict(env or {})
+        self._procs: list[mp.Process] = []
+
+    def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
+        ctx = mp.get_context("spawn")
+        for i, config in enumerate(configs):
+            config.env = {**self.env, **config.env}
+            log_path = os.path.join(log_dir, f"node_{i}.log") if log_dir else None
+            payload = cloudpickle.dumps(config)
+            p = ctx.Process(target=_child_entry, args=(payload, log_path), name=f"tpu-node-{i}")
+            p.daemon = False
+            p.start()
+            self._procs.append(p)
+
+    @property
+    def processes(self) -> list[mp.Process]:
+        return list(self._procs)
+
+    def join(self, timeout: float | None = None) -> bool:
+        """Join all node processes; True if all exited within the timeout."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self._procs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            p.join(remaining)
+        return all(p.exitcode is not None for p in self._procs)
+
+    def alive(self) -> list[int]:
+        return [i for i, p in enumerate(self._procs) if p.is_alive()]
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(5.0)
+            if p.is_alive():
+                p.kill()
+
+
+class TPUPodLauncher:
+    """Placement across the hosts of a TPU pod slice (scaffold).
+
+    One node process per TPU-VM host; each process sees that host's chips and
+    joins the global mesh via ``jax.distributed`` (``NodeConfig.jax_distributed``).
+    Transport (ssh / GKE Jobset / queued resources) is deployment-specific and
+    injected as a ``spawn_fn(host, command) -> handle``.
+    """
+
+    def __init__(self, hosts: list[str], spawn_fn=None):
+        self.hosts = hosts
+        self.spawn_fn = spawn_fn
+
+    def launch(self, configs, log_dir=None):  # pragma: no cover - needs a pod
+        if self.spawn_fn is None:
+            raise NotImplementedError(
+                "TPUPodLauncher needs a spawn_fn (ssh/GKE transport); "
+                "use LocalLauncher for single-host runs"
+            )
+        for host, config in zip(self.hosts, configs):
+            payload = cloudpickle.dumps(config)
+            self.spawn_fn(host, payload)

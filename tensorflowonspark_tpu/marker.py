@@ -1,0 +1,29 @@
+"""Sentinel markers for the streaming data plane.
+
+Parity with ``tensorflowonspark/marker.py:~1-25`` (reference): ``Marker`` base
+and ``EndPartition`` (end of one streamed partition).  We add an explicit
+``EndOfFeed`` sentinel where the reference used a bare ``None`` pushed by
+``TFSparkNode.shutdown`` (``TFSparkNode.py:~590-660``) — an explicit type is
+safer when ``None`` may be legitimate user data.
+"""
+
+
+class Marker:
+    """Base class for control markers placed in data queues."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return f"<{type(self).__name__}>"
+
+
+class EndPartition(Marker):
+    """End of a single streamed partition (reference ``marker.EndPartition``)."""
+
+    __slots__ = ()
+
+
+class EndOfFeed(Marker):
+    """No more data will ever arrive; consumers should finish up."""
+
+    __slots__ = ()

@@ -1,0 +1,5 @@
+"""Model zoo: the reference's example model families, rebuilt in Flax.
+
+Reference ``examples/``: mnist (CNN), imagenet/inception (Inception-v3),
+resnet (ResNet-50), criteo (wide-and-deep).  SURVEY.md §6 parity configs.
+"""

@@ -1,0 +1,278 @@
+"""Per-host node runtime — the ``TFSparkNode`` replacement.
+
+Reference (``tensorflowonspark/TFSparkNode.py:~140-420``): a Spark task on
+each executor derives its executor id, allocates GPUs into
+``CUDA_VISIBLE_DEVICES``, starts TFManager queues, registers with the
+reservation server, writes ``TF_CONFIG``, optionally spawns TensorBoard, then
+invokes the user ``map_fun(args, ctx)``.
+
+TPU-native redesign (BASELINE.json:5, SURVEY.md §7.1-3):
+- the coordinator *assigns* ``executor_id``/role at registration (race-free,
+  replacing partition-id derivation and ``gpu_info.py`` GPU-pick retries);
+- instead of ``CUDA_VISIBLE_DEVICES`` the node receives **mesh coordinates**:
+  its process index and the global device mesh layout; accelerator visibility
+  is whatever JAX exposes on this host (TPU chips are per-host hardware, not
+  a shared pool to race over);
+- instead of ``TF_CONFIG`` + ``tf.train.Server``, multi-host XLA is set up
+  via ``jax.distributed.initialize`` (SPMD over ICI/DCN) when
+  ``jax_distributed`` is enabled;
+- ``map_fun`` runs in the node process's main thread — there is no Spark task
+  slot to give back, so the reference's background-process fork
+  (``TFSparkNode.py:~300-420``) and its cross-process manager queues are
+  unnecessary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Sequence
+
+from tensorflowonspark_tpu.coordinator import CoordinatorClient
+from tensorflowonspark_tpu.dataserver import DataServer
+from tensorflowonspark_tpu.feeding import DataFeed, FeedQueues
+from tensorflowonspark_tpu.marker import EndOfFeed
+from tensorflowonspark_tpu.utils import paths as _paths
+from tensorflowonspark_tpu.utils.net import local_ip
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    """Everything a node process needs to join the cluster."""
+
+    coordinator_addr: tuple[str, int]
+    authkey: bytes
+    map_fun: Callable[[Any, "NodeContext"], Any]
+    tf_args: Any = None
+    queues: Sequence[str] = ("input", "output", "error")
+    input_qnames: Sequence[str] = ("input",)
+    queue_capacity: int = 1024
+    feed_timeout: float = 600.0
+    reservation_timeout: float = 120.0
+    default_fs: str = ""
+    working_dir: str = ""
+    log_dir: str = ""
+    tensorboard: bool = False
+    jax_distributed: bool = False
+    heartbeat_interval: float = 2.0
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class NodeContext:
+    """The ``ctx`` handed to user ``map_fun`` (reference ``TFNodeContext``,
+    ``TFSparkNode.py:~27-60``), extended with TPU mesh facilities."""
+
+    def __init__(
+        self,
+        executor_id: int,
+        job_name: str,
+        task_index: int,
+        num_executors: int,
+        cluster_info: list[dict],
+        queues: FeedQueues,
+        config: NodeConfig,
+        client: CoordinatorClient,
+    ):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.num_executors = num_executors
+        self.cluster_info = cluster_info
+        self.queues = queues
+        self.default_fs = config.default_fs
+        self.working_dir = config.working_dir or os.getcwd()
+        self.log_dir = config.log_dir
+        self.tf_args = config.tf_args
+        self._config = config
+        self._client = client
+        self.stop_requested = threading.Event()
+
+    # -- data plane ----------------------------------------------------------
+
+    def get_data_feed(
+        self,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict | None = None,
+    ) -> DataFeed:
+        """Reference: ``TFNode.DataFeed(ctx.mgr, ...)`` (``TFNode.py:~250``)."""
+        return DataFeed(self.queues, train_mode, qname_in, qname_out, input_mapping)
+
+    # -- path plumbing -------------------------------------------------------
+
+    def absolute_path(self, path: str) -> str:
+        """Reference: ``TFNode.hdfs_path(ctx, path)`` (``TFNode.py:~30-70``)."""
+        return _paths.absolute_path(path, self.default_fs, self.working_dir)
+
+    # -- mesh / SPMD ---------------------------------------------------------
+
+    def make_mesh(self, **axis_sizes: int):
+        """Build a ``jax.sharding.Mesh`` over this process's visible devices.
+
+        The TPU replacement for ``TFNode.start_cluster_server``
+        (``TFNode.py:~80-150``): no server objects — just a named mesh that
+        jit-compiled SPMD programs shard over (XLA collectives over ICI).
+        """
+        from tensorflowonspark_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(**axis_sizes)
+
+    # -- global consensus (sync SPMD end-of-data, SURVEY.md §7.3-1) ----------
+
+    @property
+    def num_data_nodes(self) -> int:
+        """Nodes that participate in the data plane (everything but evaluator)."""
+        return sum(1 for m in self.cluster_info if m["job_name"] != "evaluator")
+
+    def all_done(self, done: bool, timeout: float = 300.0) -> bool:
+        """Control-plane all-reduce: True only when *every* data node is done.
+
+        Sync data-parallel training cannot let one host run out of data early
+        (SURVEY.md §5.8-3); call this each epoch/partition boundary.  Scoped
+        to data nodes — the evaluator never sees the feed and must not be
+        counted, or the reduce would deadlock.
+        """
+        name = self._client.next_collective_name("all_done")
+        return bool(self._client.reduce(name, bool(done), kind="all", timeout=timeout,
+                                        count=self.num_data_nodes))
+
+    def any_done(self, done: bool, timeout: float = 300.0) -> bool:
+        name = self._client.next_collective_name("any_done")
+        return bool(self._client.reduce(name, bool(done), kind="any", timeout=timeout,
+                                        count=self.num_data_nodes))
+
+    def barrier(self, name: str = "user", timeout: float = 300.0, group: str = "all") -> None:
+        """Block until all participants arrive; ``group='data'`` excludes the
+        evaluator (use it in code paths the evaluator never runs)."""
+        count = self.num_data_nodes if group == "data" else None
+        self._client.barrier(f"{name}:{_next_barrier_id()}", self.executor_id, timeout, count=count)
+
+
+_barrier_counter = [0]
+
+
+def _next_barrier_id() -> int:
+    _barrier_counter[0] += 1
+    return _barrier_counter[0]
+
+
+def _start_tensorboard(log_dir: str) -> tuple[subprocess.Popen | None, str | None]:
+    """Spawn TensorBoard on a free port (reference ``TFSparkNode.py:~300-330``)."""
+    try:
+        from tensorflowonspark_tpu.utils.net import find_free_port
+
+        port = find_free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tensorboard.main", "--logdir", log_dir,
+             "--port", str(port), "--bind_all"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return proc, f"http://{local_ip()}:{port}"
+    except Exception:
+        logger.warning("could not launch tensorboard", exc_info=True)
+        return None, None
+
+
+def node_main(config: NodeConfig) -> int:
+    """Entry point of one node process; returns a process exit code."""
+    for k, v in config.env.items():
+        os.environ[k] = v
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [node %(process)d] %(name)s: %(message)s",
+        force=True,
+    )
+
+    client = CoordinatorClient(config.coordinator_addr)
+    queues = FeedQueues(config.queues, config.queue_capacity)
+    server = DataServer(queues, config.authkey, config.feed_timeout)
+    data_port = server.start()
+
+    ident = client.register({"host": local_ip(), "data_port": data_port, "pid": os.getpid()})
+    executor_id = ident["executor_id"]
+    cluster_info = client.await_cluster(timeout=config.reservation_timeout)
+
+    tb_proc = None
+    # The chief is always executor 0 whatever its role is named (master_node
+    # lets users rename it), so key on id, not on the name.
+    if config.tensorboard and executor_id == 0 and config.log_dir:
+        tb_proc, tb_url = _start_tensorboard(config.log_dir)
+        if tb_url:
+            client.update_meta(executor_id, {"tb_url": tb_url})
+
+    if config.jax_distributed:
+        # Real multi-host SPMD: one JAX process per host over DCN.  The chief
+        # picks a free port on its own host and distributes it through a
+        # control-plane max-reduce (everyone else contributes -1), so no node
+        # guesses at unreserved ports (SURVEY.md §5.2 race class).
+        import jax
+
+        from tensorflowonspark_tpu.utils.net import find_free_port
+
+        port = find_free_port() if executor_id == 0 else -1
+        port = int(client.reduce("jax_coordinator_port", port, kind="max",
+                                 timeout=config.reservation_timeout))
+        chief_host = cluster_info[0]["host"]
+        jax.distributed.initialize(
+            coordinator_address=f"{chief_host}:{port}",
+            num_processes=len(cluster_info),
+            process_id=executor_id,
+        )
+
+    ctx = NodeContext(
+        executor_id=executor_id,
+        job_name=ident["job_name"],
+        task_index=ident["task_index"],
+        num_executors=len(cluster_info),
+        cluster_info=cluster_info,
+        queues=queues,
+        config=config,
+        client=client,
+    )
+
+    def _heartbeat_loop() -> None:
+        while not ctx.stop_requested.is_set():
+            try:
+                if client.heartbeat(executor_id):
+                    # Driver asked us to stop: unblock any DataFeed consumer so
+                    # map_fun can exit (zombie-free teardown, SURVEY.md §7.3-5).
+                    ctx.stop_requested.set()
+                    for qname in config.input_qnames:
+                        queues.get_queue(qname).put(EndOfFeed())
+                    return
+            except Exception:
+                return  # coordinator gone; driver exited
+            time.sleep(config.heartbeat_interval)
+
+    hb = threading.Thread(target=_heartbeat_loop, daemon=True, name="heartbeat")
+    hb.start()
+
+    exit_code = 0
+    try:
+        logger.info("node %d (%s:%d) invoking map_fun", executor_id, ident["job_name"], ident["task_index"])
+        config.map_fun(config.tf_args, ctx)
+    except Exception:
+        tb = traceback.format_exc()
+        logger.error("map_fun failed:\n%s", tb)
+        try:
+            client.report_error(executor_id, tb)
+        except Exception:
+            pass
+        exit_code = 1
+    finally:
+        ctx.stop_requested.set()
+        server.stop()
+        if tb_proc is not None:
+            tb_proc.terminate()
+        client.close()
+    return exit_code

@@ -1,0 +1,1 @@
+"""Custom ops: Pallas TPU kernels for the hot paths."""

@@ -1,0 +1,13 @@
+"""Parallelism: device meshes, SPMD data parallelism, sharding helpers.
+
+The tensor plane of the framework (SURVEY.md §5.8-2): XLA collectives over
+ICI emitted by jit-compiled SPMD programs — no server objects, no NCCL.
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated,
+    shard_batch,
+)

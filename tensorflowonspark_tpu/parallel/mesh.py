@@ -1,0 +1,104 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference handed out ``CUDA_VISIBLE_DEVICES`` strings (``gpu_info.py``)
+and wired nodes via ``TF_CONFIG`` (``TFSparkNode.py:~260-300``).  The TPU
+equivalent of "cluster wiring" is a named ``jax.sharding.Mesh``: SPMD
+programs annotate shardings over its axes and XLA inserts the collectives
+(all-reduce over ICI for data-parallel gradients, etc.).
+
+Axis convention (SURVEY.md §2.3 disposition column):
+- ``dp``   — data parallelism (the reference's only strategy, now sync SPMD);
+- ``fsdp`` — parameter-sharded data parallelism (zero-style);
+- ``tp``   — tensor/model parallelism (reference: absent; first-class here);
+- ``sp``   — sequence/context parallelism for long-context (ring attention);
+- ``ep``   — expert parallelism;
+- ``pp``   — pipeline parallelism.
+Unused axes default to size 1 so one mesh shape serves every model family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named mesh layout; axes omitted at construction default to 1."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axis_sizes())
+
+    def axis_sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None, **axis_sizes: int) -> Mesh:
+    """Build a Mesh with the standard axis names.
+
+    Any axis given as ``-1`` absorbs the remaining devices (like a reshape
+    wildcard).  With no axes at all, everything lands on ``dp``.
+
+    On real hardware, ``jax.devices()`` order already reflects ICI topology
+    (jax returns devices in a topology-aware order); axis order places the
+    innermost axes (``pp`` last) on the nearest neighbours, so put the
+    bandwidth-hungry axis (``tp``/``sp``) after ``dp``/``fsdp`` as this
+    layout does.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = {a: int(axis_sizes.get(a, 1)) for a in AXES}
+    unknown = set(axis_sizes) - set(AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+    wilds = [a for a, s in sizes.items() if s == -1]
+    if len(wilds) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wilds:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[wilds[0]] = n // fixed
+    elif not axis_sizes:
+        sizes["dp"] = n
+    elif fixed != n:
+        raise ValueError(f"mesh axes product {fixed} != device count {n}")
+    arr = np.array(devices).reshape([sizes[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def spec(mesh: Mesh) -> MeshSpec:
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return MeshSpec(**{a: shape.get(a, 1) for a in AXES})
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """Sharding for a batch: leading dim split over (dp, fsdp), rest replicated."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), *([None] * extra_dims)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch onto the mesh, sharded along the leading axis."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), *([None] * (x.ndim - 1))))),
+        batch,
+    )

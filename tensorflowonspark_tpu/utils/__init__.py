@@ -1,0 +1,4 @@
+"""Utility helpers (networking, paths, logging)."""
+
+from tensorflowonspark_tpu.utils.net import find_free_port, local_ip  # noqa: F401
+from tensorflowonspark_tpu.utils.paths import absolute_path, register_fs_root  # noqa: F401

@@ -1,0 +1,39 @@
+"""Networking helpers.
+
+Parity with ``tensorflowonspark/util.py:~1-50`` (find free port / loopback
+detection).  Unlike the reference — which binds a port, releases it, and
+re-binds later (the ``release_port`` race documented in SURVEY.md §5.2) — we
+prefer handing live, already-bound sockets to their consumers so there is no
+bind-then-release window.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def find_free_port(host: str = "") -> int:
+    """Return a currently-free TCP port (note: racy; prefer bound_socket)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def bound_socket(host: str = "") -> socket.socket:
+    """Return a listening socket bound to an OS-assigned port (race-free)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    s.listen(128)
+    return s
+
+
+def local_ip() -> str:
+    """Best-effort non-loopback IP of this host, else 127.0.0.1."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            # No packets are sent; this just selects a routable interface.
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

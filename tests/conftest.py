@@ -1,0 +1,35 @@
+"""Test session setup.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real multi-process
+clusters on localhost (their ``local-cluster[2,1,1024]`` trick) and, for mesh
+logic, a virtual 8-device CPU platform
+(``--xla_force_host_platform_device_count=8``), since multi-chip TPU hardware
+is not available here.
+
+This environment force-registers a TPU PJRT plugin from ``sitecustomize`` at
+interpreter start, which overrides ``JAX_PLATFORMS=cpu`` even when set before
+``import jax``.  Tests must never touch the (single, exclusive) TPU — and
+spawned node processes would each try to claim it too.  So on first import we
+re-exec the test process once with a cleaned environment; node child
+processes inherit it.
+"""
+
+import os
+import sys
+
+if os.environ.get("_TOS_TEST_CLEAN") != "1":
+    if "jax" in sys.modules:  # too late to fix the platform; proceed as-is
+        sys.stderr.write("conftest: jax already imported; cannot force CPU platform\n")
+    else:
+        env = dict(os.environ)
+        env["_TOS_TEST_CLEAN"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        # An empty value disables the sitecustomize TPU-plugin registration
+        # in this process and every spawned node process.
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+os.environ.setdefault("TPU_FRAMEWORK_TEST", "1")

@@ -1,0 +1,104 @@
+"""Module-level map_funs used by cluster end-to-end tests.
+
+Kept importable (not closures) so they ship cleanly to spawned node
+processes, the way the reference's examples define ``main_fun`` at module
+scope for Spark closure serialization.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def noop(args, ctx):
+    """Register, do nothing, exit."""
+    return None
+
+
+def sum_batches(args, ctx):
+    """Drain the feed summing numbers; write the total to args['out_dir']."""
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0.0
+    count = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch_size"])
+        total += sum(batch)
+        count += len(batch)
+    out = os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{total} {count}")
+
+
+def echo_inference(args, ctx):
+    """Classic inference loop: read batches, emit one result per input item."""
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(4)
+        if batch:
+            feed.batch_results([x * 2 for x in batch])
+
+
+def early_terminator(args, ctx):
+    """Consume a few items then terminate — exercises the fast-drain path."""
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(args["consume"])
+    feed.terminate()
+
+
+def failing(args, ctx):
+    raise ValueError("intentional failure for error propagation test")
+
+
+def barrier_user(args, ctx):
+    """Exercise ctx.barrier and the all_done consensus."""
+    ctx.barrier("start")
+    # Node i claims done after i+1 rounds; all_done must only turn True when
+    # every node is done (sync SPMD end-of-data consensus, SURVEY.md §7.3-1).
+    rounds = 0
+    me_done = False
+    while True:
+        rounds += 1
+        me_done = rounds > ctx.executor_id
+        if ctx.all_done(me_done):
+            break
+        time.sleep(0.01)
+    out = os.path.join(args["out_dir"], f"rounds_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(str(rounds))
+
+
+def consensus_with_eval(args, ctx):
+    """Evaluator never touches the feed/consensus; data nodes still converge."""
+    if ctx.job_name == "evaluator":
+        return
+    rounds = 0
+    while True:
+        rounds += 1
+        if ctx.all_done(rounds > ctx.executor_id):
+            break
+    out = os.path.join(args["out_dir"], f"rounds_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(str(rounds))
+
+
+def writes_role(args, ctx):
+    out = os.path.join(args["out_dir"], f"role_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{ctx.job_name}:{ctx.task_index}:{ctx.num_executors}")
+
+
+def custom_queue_consumer(args, ctx):
+    """Consume from a non-default input queue name until EOF."""
+    feed = ctx.get_data_feed(qname_in="train_q")
+    seen = []
+    while not feed.should_stop():
+        seen.extend(feed.next_batch(3))
+    with open(os.path.join(args["out_dir"], f"node_{ctx.executor_id}_custom.txt"), "w") as f:
+        f.write(str(seen))
+
+
+def hangs_forever(args, ctx):
+    """Ignores EOF and stop signals (zombie teardown probe)."""
+    while True:
+        time.sleep(0.5)

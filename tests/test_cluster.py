@@ -1,0 +1,111 @@
+"""End-to-end cluster lifecycle tests with real node processes
+(reference: ``test/test_TFCluster.py`` over ``local-cluster[2,1,1024]``,
+SURVEY.md §4 — no mocks, real processes + real sockets)."""
+
+import os
+
+import pytest
+
+import tensorflowonspark_tpu as tos
+from tensorflowonspark_tpu.cluster import InputMode
+
+from tests import mapfuns
+
+
+def test_input_mode_aliases():
+    assert InputMode.TENSORFLOW is InputMode.DIRECT
+    assert InputMode.SPARK is InputMode.STREAMING
+
+
+def test_run_and_shutdown_noop():
+    cluster = tos.run(mapfuns.noop, num_executors=2, reservation_timeout=60)
+    assert len(cluster.cluster_info) == 2
+    assert cluster.cluster_info[0]["job_name"] == "chief"
+    cluster.shutdown()
+
+
+def test_roles_and_ctx(tmp_path):
+    args = {"out_dir": str(tmp_path)}
+    cluster = tos.run(
+        mapfuns.writes_role, args, num_executors=3, eval_node=True, reservation_timeout=60
+    )
+    cluster.shutdown()
+    roles = sorted((tmp_path / f"role_{i}.txt").read_text() for i in range(3))
+    assert roles == ["chief:0:3", "evaluator:0:3", "worker:0:3"]
+
+
+def test_train_streaming_sums(tmp_path):
+    args = {"out_dir": str(tmp_path), "batch_size": 5}
+    cluster = tos.run(
+        mapfuns.sum_batches,
+        args,
+        num_executors=2,
+        input_mode=InputMode.STREAMING,
+        reservation_timeout=60,
+    )
+    data = tos.PartitionedDataset.from_iterable(range(100), 4)
+    cluster.train(data, num_epochs=2)
+    cluster.shutdown()
+    totals, counts = 0.0, 0
+    for i in range(2):
+        t, c = (tmp_path / f"node_{i}.txt").read_text().split()
+        totals += float(t)
+        counts += int(c)
+    assert counts == 200  # every item delivered exactly once per epoch
+    assert totals == 2 * sum(range(100))
+
+
+def test_inference_ordered_exact(tmp_path):
+    cluster = tos.run(
+        mapfuns.echo_inference,
+        {},
+        num_executors=2,
+        input_mode=InputMode.STREAMING,
+        reservation_timeout=60,
+    )
+    data = tos.PartitionedDataset.from_iterable(range(57), 5)
+    results = cluster.inference(data)
+    cluster.shutdown()
+    assert results == [x * 2 for x in range(57)]  # ordered, exactly-count
+
+
+def test_error_propagation():
+    cluster = tos.run(mapfuns.failing, num_executors=2, reservation_timeout=60)
+    with pytest.raises(RuntimeError, match="intentional failure"):
+        cluster.shutdown()
+
+
+def test_early_termination_fast_drain(tmp_path):
+    args = {"consume": 3}
+    cluster = tos.run(
+        mapfuns.early_terminator,
+        args,
+        num_executors=1,
+        input_mode=InputMode.STREAMING,
+        reservation_timeout=60,
+    )
+    # far more data than the node will consume; must not hang
+    data = tos.PartitionedDataset.from_iterable(range(50_000), 2)
+    cluster.train(data)
+    cluster.shutdown()
+
+
+def test_consensus_excludes_evaluator(tmp_path):
+    """all_done must be scoped to data nodes or it deadlocks with eval_node."""
+    args = {"out_dir": str(tmp_path)}
+    cluster = tos.run(
+        mapfuns.consensus_with_eval, args, num_executors=3, eval_node=True,
+        reservation_timeout=60,
+    )
+    cluster.shutdown(timeout=60)
+    rounds = [int((tmp_path / f"rounds_{i}.txt").read_text()) for i in range(2)]
+    assert rounds == [2, 2]
+
+
+def test_global_done_consensus(tmp_path):
+    args = {"out_dir": str(tmp_path)}
+    cluster = tos.run(mapfuns.barrier_user, args, num_executors=3, reservation_timeout=60)
+    cluster.shutdown()
+    rounds = [int((tmp_path / f"rounds_{i}.txt").read_text()) for i in range(3)]
+    # all nodes leave the loop on the same (last) round: consensus, not local state
+    assert rounds == [3, 3, 3]

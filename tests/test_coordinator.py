@@ -1,0 +1,118 @@
+"""Coordinator rendezvous tests (reference: ``test/test_reservation.py``)."""
+
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.coordinator import CoordinatorClient, CoordinatorServer
+
+
+def test_register_and_await():
+    server = CoordinatorServer(expected=3)
+    addr = server.start()
+    infos = []
+
+    def node(i):
+        c = CoordinatorClient(addr)
+        ident = c.register({"host": "127.0.0.1", "data_port": 1000 + i})
+        nodes = c.await_cluster(timeout=10)
+        infos.append((ident, nodes))
+        c.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    cluster = server.await_registrations(timeout=10)
+    for t in threads:
+        t.join()
+    server.stop()
+
+    assert len(cluster) == 3
+    assert [m["executor_id"] for m in cluster] == [0, 1, 2]
+    assert cluster[0]["job_name"] == "chief"
+    assert {m["job_name"] for m in cluster[1:]} == {"worker"}
+    # every client saw the same complete cluster
+    for _, nodes in infos:
+        assert [m["executor_id"] for m in nodes] == [0, 1, 2]
+    # assigned ids are unique
+    assert sorted(i["executor_id"] for i, _ in infos) == [0, 1, 2]
+
+
+def test_await_timeout():
+    server = CoordinatorServer(expected=2)
+    addr = server.start()
+    c = CoordinatorClient(addr)
+    c.register({})
+    with pytest.raises(TimeoutError):
+        server.await_registrations(timeout=0.3)
+    c.close()
+    server.stop()
+
+
+def test_reduce_and_barrier():
+    server = CoordinatorServer(expected=3)
+    addr = server.start()
+    results = {}
+
+    def node(i):
+        c = CoordinatorClient(addr)
+        c.register({})
+        results[(i, "sum")] = c.reduce("g1", i, kind="sum", timeout=10)
+        results[(i, "all")] = c.reduce("g2", i > 0, kind="all", timeout=10)
+        results[(i, "any")] = c.reduce("g3", i == 2, kind="any", timeout=10)
+        results[(i, "gather")] = sorted(c.reduce("g4", i, kind="gather", timeout=10))
+        c.barrier("b1", i, timeout=10)
+        c.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    for i in range(3):
+        assert results[(i, "sum")] == 3
+        assert results[(i, "all")] is False
+        assert results[(i, "any")] is True
+        assert results[(i, "gather")] == [0, 1, 2]
+
+
+def test_error_reporting_and_heartbeat_stop():
+    server = CoordinatorServer(expected=1)
+    addr = server.start()
+    c = CoordinatorClient(addr)
+    c.register({})
+    assert c.heartbeat(0) is False
+    c.report_error(0, "Traceback: boom")
+    server.signal_stop()
+    assert c.heartbeat(0) is True
+    errs = server.errors()
+    assert len(errs) == 1 and "boom" in errs[0]["traceback"]
+    c.close()
+    server.stop()
+
+
+def test_update_meta():
+    server = CoordinatorServer(expected=1)
+    addr = server.start()
+    c = CoordinatorClient(addr)
+    c.register({"host": "h"})
+    c.update_meta(0, {"tb_url": "http://x:1"})
+    assert server.cluster_info()[0]["tb_url"] == "http://x:1"
+    c.close()
+    server.stop()
+
+
+def test_dead_node_detection():
+    server = CoordinatorServer(expected=1)
+    addr = server.start()
+    c = CoordinatorClient(addr)
+    c.register({})
+    assert server.dead_nodes(heartbeat_timeout=5.0) == []
+    time.sleep(0.2)
+    assert server.dead_nodes(heartbeat_timeout=0.1) == [0]
+    c.heartbeat(0)
+    assert server.dead_nodes(heartbeat_timeout=0.15) == []
+    c.close()
+    server.stop()

@@ -1,0 +1,56 @@
+"""PartitionedDataset tests."""
+
+import pytest
+
+from tensorflowonspark_tpu.data import PartitionedDataset, as_partitioned
+
+
+def test_from_iterable_split():
+    ds = PartitionedDataset.from_iterable(range(10), 3)
+    assert ds.num_partitions == 3
+    parts = [list(ds.iter_partition(i)) for i in range(3)]
+    assert parts == [[0, 1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    assert list(ds) == list(range(10))
+
+
+def test_map_lazy():
+    ds = PartitionedDataset.from_iterable(range(4), 2).map(lambda x: x + 1)
+    assert list(ds) == [1, 2, 3, 4]
+    # re-iterable
+    assert list(ds.iter_partition(0)) == [1, 2]
+    assert list(ds.iter_partition(0)) == [1, 2]
+
+
+def test_from_files(tmp_path):
+    for i in range(3):
+        (tmp_path / f"part-{i}.txt").write_text(f"{i}a\n{i}b\n")
+
+    def reader(path):
+        with open(path) as f:
+            for line in f:
+                yield line.strip()
+
+    ds = PartitionedDataset.from_files(str(tmp_path / "part-*.txt"), reader)
+    assert ds.num_partitions == 3
+    assert list(ds) == ["0a", "0b", "1a", "1b", "2a", "2b"]
+
+
+def test_from_files_missing():
+    with pytest.raises(FileNotFoundError):
+        PartitionedDataset.from_files("/nonexistent/zzz-*", lambda p: iter(()))
+
+
+def test_as_partitioned_forms():
+    ds = as_partitioned([[1, 2], [3]], 5)
+    assert ds.num_partitions == 2
+    ds2 = as_partitioned([(1, 2), (3, 4)], 2)  # tuples are samples
+    assert ds2.num_partitions == 2
+    assert list(ds2) == [(1, 2), (3, 4)]
+    ds3 = as_partitioned(ds, 9)
+    assert ds3 is ds
+
+
+def test_repartition():
+    ds = PartitionedDataset.from_iterable(range(6), 2).repartition(3)
+    assert ds.num_partitions == 3
+    assert list(ds) == list(range(6))

@@ -1,0 +1,94 @@
+"""Data-plane server/client tests (replaces the reference's manager-queue
+feeding paths, SURVEY.md §3.2/§3.3)."""
+
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu.dataserver import DataClient, DataServer
+from tensorflowonspark_tpu.feeding import DataFeed, FeedQueues
+
+AUTH = b"secret"
+
+
+def start_pair(feed_timeout=5.0, capacity=1024):
+    queues = FeedQueues(capacity=capacity)
+    server = DataServer(queues, AUTH, feed_timeout=feed_timeout)
+    port = server.start()
+    client = DataClient("127.0.0.1", port, AUTH, chunk_size=8)
+    return queues, server, client
+
+
+def test_feed_partition_and_markers():
+    queues, server, client = start_pair()
+    feed = DataFeed(queues)
+    state = client.feed_partition(range(20))
+    assert state == "running"
+    client.send_eof()
+    assert feed.next_batch(100) == list(range(20))
+    assert feed.next_batch(1) == []
+    assert feed.should_stop()
+    client.close()
+    server.stop()
+
+
+def test_auth_rejected():
+    queues = FeedQueues()
+    server = DataServer(queues, AUTH)
+    port = server.start()
+    with pytest.raises(RuntimeError, match="auth"):
+        DataClient("127.0.0.1", port, b"wrong")
+    server.stop()
+
+
+def test_infer_exactly_count_ordered():
+    queues, server, client = start_pair()
+
+    def model():
+        feed = DataFeed(queues, train_mode=False)
+        while not feed.should_stop():
+            batch = feed.next_batch(4)
+            if batch:
+                feed.batch_results([x * x for x in batch])
+
+    t = threading.Thread(target=model, daemon=True)
+    t.start()
+    results = client.infer_partition(list(range(30)))
+    assert results == [x * x for x in range(30)]
+    client.send_eof()
+    t.join(5)
+    client.close()
+    server.stop()
+
+
+def test_infer_empty_partition():
+    queues, server, client = start_pair()
+    assert client.infer_partition([]) == []
+    client.close()
+    server.stop()
+
+
+def test_terminating_fast_drain():
+    queues, server, client = start_pair()
+    feed = DataFeed(queues)
+    feed.terminate()
+    state = client.feed_partition(range(10_000))
+    assert state == "terminating"
+    client.close()
+    server.stop()
+
+
+def test_feed_timeout_when_consumer_stalls():
+    queues, server, client = start_pair(feed_timeout=0.3, capacity=4)
+    with pytest.raises(RuntimeError, match="feed timeout"):
+        client.feed_partition(range(100))
+    client.close()
+    server.stop()
+
+
+def test_infer_timeout_when_model_absent():
+    queues, server, client = start_pair(feed_timeout=0.3)
+    with pytest.raises(RuntimeError, match="inference produced"):
+        client.infer_partition([1, 2, 3])
+    client.close()
+    server.stop()

@@ -1,0 +1,93 @@
+"""DataFeed / FeedQueues semantics (reference ``TFNode.DataFeed`` spec,
+SURVEY.md §3.2 + §4 'queue/timeout edge cases')."""
+
+import threading
+
+from tensorflowonspark_tpu.feeding import DataFeed, FeedQueues
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+
+
+def make_feed(**kw):
+    queues = FeedQueues()
+    return queues, DataFeed(queues, **kw)
+
+
+def test_next_batch_full_and_partial():
+    queues, feed = make_feed()
+    q = queues.get_queue("input")
+    for i in range(5):
+        q.put(i)
+    q.put(EndPartition())
+    q.put(EndOfFeed())
+    assert feed.next_batch(3) == [0, 1, 2]
+    # partial batch at end of partition
+    assert feed.next_batch(3) == [3, 4]
+    assert not feed.should_stop()
+    # end of feed -> empty batch, done_feeding set
+    assert feed.next_batch(3) == []
+    assert feed.should_stop()
+
+
+def test_empty_partition_skipped():
+    queues, feed = make_feed()
+    q = queues.get_queue("input")
+    q.put(EndPartition())  # empty partition should not yield an empty batch
+    q.put(7)
+    q.put(EndOfFeed())
+    assert feed.next_batch(2) == [7]
+
+
+def test_none_is_ordinary_data():
+    # Delta from the reference (which used bare None as end-of-feed): samples
+    # with optional fields must survive the feed; only EndOfFeed terminates.
+    queues, feed = make_feed()
+    q = queues.get_queue("input")
+    q.put(None)
+    q.put(1)
+    q.put(EndOfFeed())
+    assert feed.next_batch(5) == [None, 1]
+    assert feed.should_stop()
+
+
+def test_input_mapping_columns():
+    queues, feed = make_feed(input_mapping={"col_x": "x", "col_y": "y"})
+    q = queues.get_queue("input")
+    q.put((1, 10))
+    q.put((2, 20))
+    q.put(EndPartition())
+    batch = feed.next_batch(5)
+    assert batch == {"x": [1, 2], "y": [10, 20]}
+
+
+def test_batch_results_roundtrip():
+    queues, feed = make_feed(train_mode=False)
+    feed.batch_results([1, 2, 3])
+    out = queues.get_queue("output")
+    assert [out.get() for _ in range(3)] == [1, 2, 3]
+
+
+def test_terminate_drains_input():
+    queues, feed = make_feed()
+    q = queues.get_queue("input")
+    for i in range(50):
+        q.put(i)
+    feed.terminate()
+    assert queues.get("state") == "terminating"
+    assert q.qsize() == 0
+    assert feed.should_stop()
+
+
+def test_blocking_get_unblocked_by_producer():
+    queues, feed = make_feed()
+    q = queues.get_queue("input")
+    got = []
+
+    def consumer():
+        got.extend(feed.next_batch(2))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    q.put(41)
+    q.put(42)
+    t.join(5)
+    assert got == [41, 42]
