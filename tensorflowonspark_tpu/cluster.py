@@ -157,7 +157,7 @@ class TPUCluster:
             try:
                 client = self._client(executor_id)
                 for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
-                    results[p] = client.infer_partition(list(dataset.iter_partition(p)), qname_in, qname_out)
+                    results[p] = client.infer_partition(dataset.iter_partition(p), qname_in, qname_out)
             except Exception as e:
                 errors.append(e)
 

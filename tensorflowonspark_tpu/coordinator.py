@@ -44,21 +44,13 @@ def _send_msg(sock: socket.socket, obj: dict) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("socket closed mid-frame")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
 def _recv_msg(sock: socket.socket) -> dict:
-    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    from tensorflowonspark_tpu.utils.net import recv_exact
+
+    (n,) = _LEN.unpack(recv_exact(sock, 4))
     if n > _MAX_FRAME:
         raise ValueError(f"frame too large: {n}")
-    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+    return json.loads(recv_exact(sock, n).decode("utf-8"))
 
 
 class _Rendezvous:

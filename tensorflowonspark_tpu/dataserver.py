@@ -34,7 +34,7 @@ import queue
 import socket
 import struct
 import threading
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable
 
 from tensorflowonspark_tpu.feeding import FeedQueues
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
@@ -62,14 +62,22 @@ def _hmac_handshake_client(sock: socket.socket, authkey: bytes) -> bool:
     return _recv_raw(sock, 2) == b"OK"
 
 
-def _recv_raw(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("data socket closed")
-        buf.extend(chunk)
-    return bytes(buf)
+from tensorflowonspark_tpu.utils.net import recv_exact as _recv_raw  # noqa: E402
+
+
+def _force_put(q: queue.Queue, item: Any) -> None:
+    """Put a control marker even into a full queue whose consumer has stopped,
+    discarding queued-but-unconsumed data items to make room (the consumer is
+    shutting down; this mirrors the terminate fast-drain semantics)."""
+    while True:
+        try:
+            q.put_nowait(item)
+            return
+        except queue.Full:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
 
 
 def _send(sock: socket.socket, obj: Any) -> None:
@@ -157,10 +165,16 @@ class DataServer:
                     return ("err", f"feed timeout after {self.feed_timeout}s (consumer stalled?)")
             return ("ok", "running")
         if op == "end_partition":
-            self.queues.get_queue(msg[1]).put(EndPartition())
+            # data-integrity marker mid-stream: bounded wait, surface stalls
+            try:
+                self.queues.get_queue(msg[1]).put(EndPartition(), block=True, timeout=self.feed_timeout)
+            except queue.Full:
+                return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
             return ("ok",)
         if op == "eof":
-            self.queues.get_queue(msg[1]).put(EndOfFeed())
+            # shutdown marker: must always land, even if the consumer stalled
+            # with a full queue — never let the driver's teardown hang here.
+            _force_put(self.queues.get_queue(msg[1]), EndOfFeed())
             return ("ok",)
         if op == "infer":
             _, qname_in, qname_out, items = msg
@@ -168,7 +182,10 @@ class DataServer:
             qo = self.queues.get_queue(qname_out)
             for item in items:
                 qi.put(item, block=True, timeout=self.feed_timeout)
-            qi.put(EndPartition())
+            try:
+                qi.put(EndPartition(), block=True, timeout=self.feed_timeout)
+            except queue.Full:
+                return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
             results = []
             for _ in range(len(items)):
                 try:
@@ -220,7 +237,7 @@ class DataClient:
         self._call(("end_partition", qname))
         return state
 
-    def infer_partition(self, items: Sequence[Any], qname_in: str = "input", qname_out: str = "output") -> list:
+    def infer_partition(self, items: Iterable[Any], qname_in: str = "input", qname_out: str = "output") -> list:
         """Round-trip one partition; returns exactly-count ordered results."""
         items = list(items)
         results: list = []

@@ -1,0 +1,169 @@
+"""Row-data ⇄ TFRecord bridge with schema inference.
+
+The TPU-native replacement for ``tensorflowonspark/dfutil.py`` (~230 LoC):
+``saveAsTFRecords``/``loadTFRecords``/``toTFExample``/``fromTFExample``/
+``infer_schema`` operated on Spark DataFrames via the tensorflow-hadoop jar;
+here the same capabilities operate on ``PartitionedDataset`` rows (dicts)
+through the in-repo TFRecord + Example codecs — no Spark, no JVM, no TF.
+
+A "row" is a ``dict[str, value-or-list]``.  Scalars round-trip as length-1
+lists unless the schema marks them scalar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+from typing import Iterator
+
+from tensorflowonspark_tpu import example as ex
+from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.data import PartitionedDataset
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+_TYPES = ("bytes", "float", "int64")
+
+
+@dataclasses.dataclass
+class ColumnSpec:
+    name: str
+    dtype: str  # bytes | float | int64
+    scalar: bool = True
+
+
+@dataclasses.dataclass
+class Schema:
+    """Column layout of a record dataset (reference ``infer_schema``)."""
+
+    columns: list[ColumnSpec]
+
+    def to_json(self) -> str:
+        return json.dumps([dataclasses.asdict(c) for c in self.columns])
+
+    @classmethod
+    def from_json(cls, s: str) -> "Schema":
+        return cls([ColumnSpec(**c) for c in json.loads(s)])
+
+    def __getitem__(self, name: str) -> ColumnSpec:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+
+def _dtype_of(value) -> str:
+    v = value[0] if isinstance(value, (list, tuple)) and value else value
+    if isinstance(v, (bytes, bytearray, str)):
+        return "bytes"
+    if isinstance(v, bool):
+        return "int64"
+    if isinstance(v, float):
+        return "float"
+    if isinstance(v, int):
+        return "int64"
+    # numpy scalars / arrays
+    import numpy as np
+
+    if isinstance(v, np.floating):
+        return "float"
+    if isinstance(v, (np.integer, np.bool_)):
+        return "int64"
+    raise TypeError(f"unsupported value type {type(v).__name__}")
+
+
+def infer_schema(row: dict) -> Schema:
+    """Infer a Schema from one representative row (reference ``infer_schema``,
+    ``dfutil.py:~200-230``)."""
+    cols = []
+    for name in sorted(row):
+        value = row[name]
+        scalar = not isinstance(value, (list, tuple))
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            scalar = value.ndim == 0
+            value = value.tolist()
+        cols.append(ColumnSpec(name, _dtype_of(value), scalar))
+    return Schema(cols)
+
+
+def to_example(row: dict, schema: Schema | None = None) -> bytes:
+    """Serialize one row to a ``tf.train.Example`` (reference ``toTFExample``)."""
+    import numpy as np
+
+    feats = {}
+    for name, value in row.items():
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        if schema is not None:
+            dtype = schema[name].dtype
+            cast = {"bytes": lambda v: v if isinstance(v, (bytes, bytearray)) else str(v).encode(),
+                    "float": float, "int64": int}[dtype]
+            value = [cast(v) for v in value]
+        else:
+            # untyped path: floats stay floats, ints stay ints, str → bytes
+            value = [v.encode() if isinstance(v, str) else v for v in value]
+        feats[name] = list(value)
+    return ex.encode_example(feats)
+
+
+def from_example(buf: bytes, schema: Schema | None = None, binary_features: set | None = None) -> dict:
+    """Deserialize an Example into a row (reference ``fromTFExample``).
+
+    ``binary_features`` mirrors the reference's option: bytes columns listed
+    there stay ``bytes``; other bytes columns decode to ``str``.
+    """
+    raw = ex.decode_example(buf)
+    row = {}
+    for name, values in raw.items():
+        if values and isinstance(values[0], bytes) and (binary_features is None or name not in binary_features):
+            values = [v.decode("utf-8", errors="replace") for v in values]
+        if schema is not None and schema[name].scalar and len(values) == 1:
+            row[name] = values[0]
+        else:
+            row[name] = values
+    return row
+
+
+def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema | None = None) -> Schema:
+    """Write one TFRecord shard per partition (reference ``saveAsTFRecords``,
+    ``dfutil.py:~30-60``); stores the schema alongside as ``_schema.json``."""
+    output_dir = resolve_uri(output_dir)
+    os.makedirs(output_dir, exist_ok=True)
+    for p in range(data.num_partitions):
+        path = os.path.join(output_dir, f"part-r-{p:05d}")
+        with tfrecord.RecordWriter(path) as w:
+            for row in data.iter_partition(p):
+                if schema is None:
+                    schema = infer_schema(row)
+                w.write(to_example(row, schema))
+    if schema is None:
+        raise ValueError("dataset is empty; cannot infer a schema")
+    with open(os.path.join(output_dir, "_schema.json"), "w") as f:
+        f.write(schema.to_json())
+    return schema
+
+
+def load_tfrecords(input_dir: str, binary_features: set | None = None) -> tuple[PartitionedDataset, Schema | None]:
+    """Load a TFRecord directory as a PartitionedDataset of rows (reference
+    ``loadTFRecords``, ``dfutil.py:~60-100``); one partition per shard file."""
+    input_dir = resolve_uri(input_dir)
+    schema = None
+    schema_path = os.path.join(input_dir, "_schema.json")
+    if os.path.exists(schema_path):
+        with open(schema_path) as f:
+            schema = Schema.from_json(f.read())
+
+    files = sorted(f for f in _glob.glob(os.path.join(input_dir, "part-*")) if not f.endswith(".json"))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards under {input_dir}")
+
+    def reader(path: str, _schema=schema) -> Iterator[dict]:
+        for rec in tfrecord.read_records(path):
+            yield from_example(rec, _schema, binary_features)
+
+    return PartitionedDataset([(lambda f=f: reader(f)) for f in files]), schema

@@ -97,19 +97,16 @@ class DataFeed:
         batch: list = []
         while len(batch) < batch_size:
             item = q.get()
-            try:
-                if isinstance(item, EndPartition):
-                    if batch:
-                        break  # partial batch closes out the partition
-                    continue  # empty partition: keep waiting for real data
-                if isinstance(item, EndOfFeed):
-                    self.done_feeding = True
-                    break
-                if isinstance(item, Marker):
-                    continue
-                batch.append(item)
-            finally:
-                q.task_done()
+            if isinstance(item, EndPartition):
+                if batch:
+                    break  # partial batch closes out the partition
+                continue  # empty partition: keep waiting for real data
+            if isinstance(item, EndOfFeed):
+                self.done_feeding = True
+                break
+            if isinstance(item, Marker):
+                continue
+            batch.append(item)
         if self.input_mapping:
             return self._to_columns(batch)
         return batch
@@ -143,6 +140,5 @@ class DataFeed:
         while True:
             try:
                 q.get(block=True, timeout=0.05)
-                q.task_done()
             except queue.Empty:
                 return

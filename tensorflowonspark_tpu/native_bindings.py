@@ -1,0 +1,85 @@
+"""ctypes bindings for the native C++ codec (``native/tfrecord_codec.cc``).
+
+Built on demand with g++ (pybind11 is not available in this environment;
+the C ABI + ctypes keeps the toolchain to the baked-in compiler).  Importing
+this module raises if the library cannot be built/loaded — callers
+(``tfrecord._use_native``) treat that as "fall back to pure Python".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "tfrecord_codec.cc")
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native_build")
+
+
+def _build() -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    lib_path = os.path.join(_CACHE_DIR, "libtfrecord_codec.so")
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC):
+        return lib_path
+    # Build into a temp file then rename: concurrent node processes may race
+    # to build; rename is atomic so everyone ends with a whole library.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, lib_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return lib_path
+
+
+_lib = ctypes.CDLL(_build())
+
+_lib.tos_crc32c.restype = ctypes.c_uint32
+_lib.tos_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+_lib.tos_scan_records.restype = ctypes.c_int64
+_lib.tos_scan_records.argtypes = [
+    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+]
+_lib.tos_frame_record.restype = ctypes.c_uint64
+_lib.tos_frame_record.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    return _lib.tos_crc32c(data, len(data), crc)
+
+
+_SCAN_CHUNK = 65536
+
+
+def scan_records(buf: bytes, verify: bool = True):
+    """Return ([(offset, length), ...], consumed_bytes); raises on corruption."""
+    offs = (ctypes.c_uint64 * _SCAN_CHUNK)()
+    lens = (ctypes.c_uint64 * _SCAN_CHUNK)()
+    consumed = ctypes.c_uint64()
+    spans: list[tuple[int, int]] = []
+    base = 0
+    view = buf
+    while True:
+        n = _lib.tos_scan_records(view, len(view), int(verify), offs, lens,
+                                  _SCAN_CHUNK, ctypes.byref(consumed))
+        if n < 0:
+            raise ValueError(f"corrupt record at offset {base + consumed.value}")
+        spans.extend((base + offs[i], lens[i]) for i in range(n))
+        base += consumed.value
+        if n < _SCAN_CHUNK:
+            return spans, base
+        view = buf[base:]  # re-slice only for shards >64k records per pass
+
+
+def frame_record(data: bytes) -> bytes:
+    out = ctypes.create_string_buffer(16 + len(data))
+    n = _lib.tos_frame_record(data, len(data), out)
+    return out.raw[:n]

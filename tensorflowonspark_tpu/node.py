@@ -241,17 +241,32 @@ def node_main(config: NodeConfig) -> int:
     )
 
     def _heartbeat_loop() -> None:
+        # Own connection: the main client's socket can be tied up for minutes
+        # inside a blocking barrier/reduce, which would starve liveness pings
+        # and block the driver's stop signal.
+        from tensorflowonspark_tpu.dataserver import _force_put
+
+        try:
+            hb_client = CoordinatorClient(config.coordinator_addr)
+        except Exception:
+            return
+        failures = 0
         while not ctx.stop_requested.is_set():
             try:
-                if client.heartbeat(executor_id):
-                    # Driver asked us to stop: unblock any DataFeed consumer so
-                    # map_fun can exit (zombie-free teardown, SURVEY.md §7.3-5).
-                    ctx.stop_requested.set()
-                    for qname in config.input_qnames:
-                        queues.get_queue(qname).put(EndOfFeed())
-                    return
+                stop = hb_client.heartbeat(executor_id)
+                failures = 0
             except Exception:
-                return  # coordinator gone; driver exited
+                failures += 1
+                if failures >= 3:
+                    return  # coordinator gone; driver exited
+                stop = False
+            if stop:
+                # Driver asked us to stop: unblock any DataFeed consumer so
+                # map_fun can exit (zombie-free teardown, SURVEY.md §7.3-5).
+                ctx.stop_requested.set()
+                for qname in config.input_qnames:
+                    _force_put(queues.get_queue(qname), EndOfFeed())
+                return
             time.sleep(config.heartbeat_interval)
 
     hb = threading.Thread(target=_heartbeat_loop, daemon=True, name="heartbeat")

@@ -1,0 +1,84 @@
+"""TensorBoard event-file writer — hand-rolled Event/Summary protos.
+
+The reference's only observability surface is TensorBoard (SURVEY.md §5.1):
+user ``map_fun``s write summaries via TF and ``TFCluster.run(tensorboard=True)``
+spawns the viewer.  Here nodes can write scalars without TF: an event file is
+a TFRecord stream of ``Event`` protos, which we encode with the same varint
+helpers as ``example.py``:
+
+    Event   { double wall_time = 1; int64 step = 2;
+              oneof { string file_version = 3; Summary summary = 5; } }
+    Summary { repeated Value value = 1; }
+    Value   { string tag = 1; float simple_value = 2; }
+
+TensorBoard's scalar dashboard reads exactly this subset.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+from tensorflowonspark_tpu.example import _write_len_delimited, _write_varint
+from tensorflowonspark_tpu.tfrecord import RecordWriter
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+_F64 = struct.Struct("<d")
+_F32 = struct.Struct("<f")
+
+
+def _encode_value(tag: str, value: float) -> bytes:
+    out = bytearray()
+    _write_len_delimited(out, 1, tag.encode("utf-8"))
+    _write_varint(out, (2 << 3) | 5)  # field 2, 32-bit
+    out += _F32.pack(float(value))
+    return bytes(out)
+
+
+def _encode_event(wall_time: float, step: int, scalars: dict[str, float] | None,
+                  file_version: str | None = None) -> bytes:
+    out = bytearray()
+    _write_varint(out, (1 << 3) | 1)  # field 1, 64-bit double
+    out += _F64.pack(wall_time)
+    _write_varint(out, (2 << 3) | 0)  # field 2, varint
+    _write_varint(out, int(step))
+    if file_version is not None:
+        _write_len_delimited(out, 3, file_version.encode("utf-8"))
+    if scalars:
+        summary = bytearray()
+        for tag, value in scalars.items():
+            _write_len_delimited(summary, 1, _encode_value(tag, value))
+        _write_len_delimited(out, 5, bytes(summary))
+    return bytes(out)
+
+
+class SummaryWriter:
+    """Write TensorBoard scalar events (one file per writer)."""
+
+    def __init__(self, log_dir: str, filename_suffix: str = ""):
+        log_dir = resolve_uri(log_dir)
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{time.time():.0f}.{os.getpid()}{filename_suffix}"
+        self._writer = RecordWriter(os.path.join(log_dir, fname))
+        # TensorBoard requires a leading file_version event.
+        self._writer.write(_encode_event(time.time(), 0, None, file_version="brain.Event:2"))
+        self._writer.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._writer.write(_encode_event(time.time(), step, {tag: value}))
+
+    def add_scalars(self, scalars: dict[str, float], step: int) -> None:
+        self._writer.write(_encode_event(time.time(), step, scalars))
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

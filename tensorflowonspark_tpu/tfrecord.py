@@ -1,0 +1,158 @@
+"""TFRecord file codec with crc32c framing — no TensorFlow, no JVM.
+
+Replaces the reference's dependency on the external ``tensorflow-hadoop`` jar
+(``org.tensorflow.hadoop.io.TFRecord{File}InputFormat/OutputFormat``) used by
+``tensorflowonspark/dfutil.py:~30-90`` for splittable TFRecord I/O, and the
+TF runtime's own record reader (SURVEY.md §2.2).  The wire format is the
+standard TFRecord framing:
+
+    uint64 length (little-endian)
+    uint32 masked_crc32c(length_bytes)
+    byte   data[length]
+    uint32 masked_crc32c(data)
+
+crc32c is Castagnoli CRC-32 (poly 0x1EDC6F41, reflected 0x82F63B78).  A
+table-driven pure-Python implementation is the fallback; the C++ extension in
+``native/`` (slice-by-8) is used when built.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, Iterator
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_MASK_DELTA = 0xA282EAD8
+
+
+def _make_table() -> list[int]:
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_TABLE = _make_table()
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# Swapped for the native implementation when available.
+crc32c = _crc32c_py
+_native = None
+
+
+def _use_native() -> bool:
+    """Try to switch hot paths to the C++ implementation; True on success."""
+    global crc32c, _native
+    try:
+        from tensorflowonspark_tpu import native_bindings
+    except Exception:
+        return False
+    crc32c = native_bindings.crc32c
+    _native = native_bindings
+    return True
+
+
+NATIVE = _use_native()
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF)
+
+
+def frame_record(data: bytes) -> bytes:
+    """Encode one record with TFRecord framing."""
+    if _native is not None:
+        return _native.frame_record(data)
+    length = _U64.pack(len(data))
+    return length + _U32.pack(masked_crc32c(length)) + data + _U32.pack(masked_crc32c(data))
+
+
+class RecordError(ValueError):
+    pass
+
+
+def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads from a TFRecord file.
+
+    With the native codec, the whole shard is scanned in C++ (one CRC pass,
+    no per-record Python framing work); otherwise a streaming Python parser.
+    """
+    if _native is not None:
+        with open(path, "rb") as f:
+            buf = f.read()
+        try:
+            spans, consumed = _native.scan_records(buf, verify)
+        except ValueError as e:
+            raise RecordError(f"{path}: {e}") from None
+        if consumed != len(buf):
+            raise RecordError(f"{path}: truncated record at offset {consumed}")
+        for off, length in spans:
+            yield buf[off : off + length]
+        return
+    with open(path, "rb") as f:
+        offset = 0
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            if len(hdr) < 12:
+                raise RecordError(f"{path}: truncated header at offset {offset}")
+            (length,) = _U64.unpack_from(hdr, 0)
+            (length_crc,) = _U32.unpack_from(hdr, 8)
+            if verify and masked_crc32c(hdr[:8]) != length_crc:
+                raise RecordError(f"{path}: corrupt length crc at offset {offset}")
+            data = f.read(length)
+            footer = f.read(4)
+            if len(data) < length or len(footer) < 4:
+                raise RecordError(f"{path}: truncated record at offset {offset}")
+            if verify and masked_crc32c(data) != _U32.unpack(footer)[0]:
+                raise RecordError(f"{path}: corrupt data crc at offset {offset}")
+            yield data
+            offset += 12 + length + 4
+
+
+class RecordWriter:
+    """Streaming TFRecord writer."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, data: bytes) -> None:
+        self._f.write(frame_record(data))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "RecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def write_records(path: str, records: Iterable[bytes]) -> int:
+    """Write all records to one file; returns the record count."""
+    n = 0
+    with RecordWriter(path) as w:
+        for rec in records:
+            w.write(rec)
+            n += 1
+    return n

@@ -28,6 +28,17 @@ def bound_socket(host: str = "") -> socket.socket:
     return s
 
 
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise ConnectionError on EOF."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-read")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
 def local_ip() -> str:
     """Best-effort non-loopback IP of this host, else 127.0.0.1."""
     try:

@@ -1,0 +1,75 @@
+"""dfutil bridge tests: rows ⇄ TFRecord shards with schema (reference
+``test/test_dfutil.py`` round-trip incl. binary-features option)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import dfutil
+from tensorflowonspark_tpu.data import PartitionedDataset
+from tensorflowonspark_tpu.utils.paths import register_fs_root
+
+
+def rows():
+    return [
+        {"label": 1, "feat": [0.5, 1.5], "name": "alice"},
+        {"label": 0, "feat": [2.5, 3.5], "name": "bob"},
+        {"label": 1, "feat": [4.0, 5.0], "name": "carol"},
+    ]
+
+
+def test_infer_schema():
+    s = dfutil.infer_schema(rows()[0])
+    assert [c.name for c in s.columns] == ["feat", "label", "name"]
+    assert s["label"].dtype == "int64" and s["label"].scalar
+    assert s["feat"].dtype == "float" and not s["feat"].scalar
+    assert s["name"].dtype == "bytes" and s["name"].scalar
+
+
+def test_roundtrip(tmp_path):
+    ds = PartitionedDataset.from_iterable(rows(), 2)
+    schema = dfutil.save_as_tfrecords(ds, str(tmp_path / "out"))
+    loaded, schema2 = dfutil.load_tfrecords(str(tmp_path / "out"))
+    assert schema2 is not None and schema2.to_json() == schema.to_json()
+    assert loaded.num_partitions == 2
+    got = sorted(loaded, key=lambda r: r["name"])
+    want = sorted(rows(), key=lambda r: r["name"])
+    for g, w in zip(got, want):
+        assert g["label"] == w["label"]
+        assert g["name"] == w["name"]
+        assert g["feat"] == pytest.approx(w["feat"])
+
+
+def test_binary_features(tmp_path):
+    data = [{"img": b"\x00\x01\xff", "id": 7}]
+    ds = PartitionedDataset.from_iterable(data, 1)
+    dfutil.save_as_tfrecords(ds, str(tmp_path / "b"))
+    loaded, _ = dfutil.load_tfrecords(str(tmp_path / "b"), binary_features={"img"})
+    (row,) = list(loaded)
+    assert row["img"] == b"\x00\x01\xff"  # bytes preserved, scalar squeezed
+    assert row["id"] == 7
+
+
+def test_numpy_values(tmp_path):
+    data = [{"x": np.arange(4, dtype=np.float32), "y": np.int64(2)}]
+    ds = PartitionedDataset.from_iterable(data, 1)
+    dfutil.save_as_tfrecords(ds, str(tmp_path / "np"))
+    loaded, _ = dfutil.load_tfrecords(str(tmp_path / "np"))
+    (row,) = list(loaded)
+    assert row["x"] == pytest.approx([0.0, 1.0, 2.0, 3.0])
+    assert row["y"] == 2
+
+
+def test_scheme_mapped_paths(tmp_path):
+    """hdfs:// URIs must work when backed by a registered local root
+    (HopsFS parity, SURVEY.md §7.3-4)."""
+    register_fs_root("hdfs", str(tmp_path))
+    ds = PartitionedDataset.from_iterable(rows(), 1)
+    dfutil.save_as_tfrecords(ds, "hdfs://namenode/user/test/out")
+    loaded, _ = dfutil.load_tfrecords("hdfs://namenode/user/test/out")
+    assert len(list(loaded)) == 3
+
+
+def test_empty_dataset_raises(tmp_path):
+    ds = PartitionedDataset.from_iterable([], 1)
+    with pytest.raises(ValueError, match="empty"):
+        dfutil.save_as_tfrecords(ds, str(tmp_path / "e"))

@@ -1,0 +1,36 @@
+"""SummaryWriter event files must be readable by TensorBoard's own loader."""
+
+import glob
+import importlib.util
+
+import pytest
+
+from tensorflowonspark_tpu.summary import SummaryWriter
+
+HAVE_TB = importlib.util.find_spec("tensorboard") is not None
+
+
+def test_writes_event_file(tmp_path):
+    with SummaryWriter(str(tmp_path)) as w:
+        w.add_scalar("loss", 1.5, step=1)
+        w.add_scalars({"loss": 1.0, "acc": 0.5}, step=2)
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert len(files) == 1
+
+
+@pytest.mark.skipif(not HAVE_TB, reason="tensorboard not installed")
+def test_tensorboard_can_parse(tmp_path):
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    with SummaryWriter(str(tmp_path)) as w:
+        for step in range(5):
+            w.add_scalar("loss", 10.0 - step, step=step)
+        w.add_scalar("acc", 0.9, step=4)
+
+    acc = EventAccumulator(str(tmp_path))
+    acc.Reload()
+    assert set(acc.Tags()["scalars"]) == {"loss", "acc"}
+    events = acc.Scalars("loss")
+    assert [e.step for e in events] == list(range(5))
+    assert events[0].value == pytest.approx(10.0)
+    assert events[4].value == pytest.approx(6.0)

@@ -1,0 +1,110 @@
+"""TFRecord + Example codec tests (reference: ``test/test_dfutil.py``),
+including cross-validation against TensorFlow's own codecs when available."""
+
+import importlib.util
+import os
+import struct
+
+import pytest
+
+from tensorflowonspark_tpu import example as ex
+from tensorflowonspark_tpu import tfrecord
+
+HAVE_TF = importlib.util.find_spec("tensorflow") is not None
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors for CRC-32C
+    assert tfrecord._crc32c_py(b"") == 0x0
+    assert tfrecord._crc32c_py(b"a") == 0xC1D04330
+    assert tfrecord._crc32c_py(b"123456789") == 0xE3069283
+    assert tfrecord._crc32c_py(bytes(32)) == 0x8A9136AA
+
+
+def test_record_roundtrip(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    records = [b"hello", b"", b"x" * 10_000, bytes(range(256))]
+    assert tfrecord.write_records(path, records) == 4
+    assert list(tfrecord.read_records(path)) == records
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    tfrecord.write_records(path, [b"payload-abcdef"])
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF  # flip a data byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(tfrecord.RecordError, match="corrupt"):
+        list(tfrecord.read_records(path))
+
+
+def test_truncation_detected(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    tfrecord.write_records(path, [b"some payload here"])
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-6])
+    with pytest.raises(tfrecord.RecordError, match="truncated"):
+        list(tfrecord.read_records(path))
+
+
+def test_example_roundtrip():
+    feats = {
+        "label": [3],
+        "weights": [0.5, -1.25, 3.0],
+        "name": [b"alpha", b"beta"],
+        "neg": [-7, 2**40, -(2**40)],
+    }
+    buf = ex.encode_example(feats)
+    out = ex.decode_example(buf)
+    assert out["label"] == [3]
+    assert out["name"] == [b"alpha", b"beta"]
+    assert out["neg"] == [-7, 2**40, -(2**40)]
+    assert out["weights"] == pytest.approx([0.5, -1.25, 3.0])
+
+
+def test_example_scalar_and_str_coercion():
+    buf = ex.encode_example({"s": "text", "i": 5, "f": [1.5]})
+    out = ex.decode_example(buf)
+    assert out == {"s": [b"text"], "i": [5], "f": [1.5]}
+
+
+@pytest.mark.skipif(not HAVE_TF, reason="tensorflow not installed")
+def test_example_matches_tensorflow():
+    """Our encoder's bytes must parse with TF, and vice versa."""
+    import tensorflow as tf
+
+    feats = {"a": [1, -2, 3], "b": [0.25, 4.5], "c": [b"xy"]}
+    ours = ex.encode_example(feats)
+    parsed = tf.train.Example.FromString(ours)
+    assert list(parsed.features.feature["a"].int64_list.value) == [1, -2, 3]
+    assert list(parsed.features.feature["b"].float_list.value) == [0.25, 4.5]
+    assert list(parsed.features.feature["c"].bytes_list.value) == [b"xy"]
+
+    theirs = tf.train.Example(
+        features=tf.train.Features(
+            feature={
+                "a": tf.train.Feature(int64_list=tf.train.Int64List(value=[9, -9])),
+                "b": tf.train.Feature(float_list=tf.train.FloatList(value=[1.0])),
+                "c": tf.train.Feature(bytes_list=tf.train.BytesList(value=[b"z"])),
+            }
+        )
+    ).SerializeToString()
+    out = ex.decode_example(theirs)
+    assert out["a"] == [9, -9]
+    assert out["b"] == [1.0]
+    assert out["c"] == [b"z"]
+
+
+@pytest.mark.skipif(not HAVE_TF, reason="tensorflow not installed")
+def test_tfrecord_file_readable_by_tensorflow(tmp_path):
+    import tensorflow as tf
+
+    path = str(tmp_path / "x.tfrecord")
+    tfrecord.write_records(path, [b"one", b"two"])
+    got = [r.numpy() for r in tf.data.TFRecordDataset(path)]
+    assert got == [b"one", b"two"]
+
+    tf_path = str(tmp_path / "y.tfrecord")
+    with tf.io.TFRecordWriter(tf_path) as w:
+        w.write(b"three")
+    assert list(tfrecord.read_records(tf_path)) == [b"three"]
