@@ -1,0 +1,131 @@
+"""Distributed MNIST, streaming input mode — parity config 1
+(reference ``examples/mnist/spark/mnist_dist.py``: InputMode.SPARK,
+BASELINE.json:7).  The driver streams partitions of (image, label) samples
+into each node's DataFeed; nodes run a sync SPMD train step over their local
+mesh, with control-plane ``all_done`` consensus replacing the reference's
+tolerance for uneven async-PS partition exhaustion (SURVEY.md §7.3-1).
+
+Run directly:  python mnist_dist.py --num-executors 2 --steps-log 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:  # allow running straight from a checkout
+    sys.path.insert(0, _REPO)
+
+import jax
+import optax
+
+
+def main_fun(args, ctx):
+    """map_fun executed on every node (reference signature: main_fun(args, ctx))."""
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager, export_bundle
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.parallel.dp import TrainState, make_batch_iterator, make_train_step, replicate
+    from tensorflowonspark_tpu.summary import SummaryWriter
+
+    model_config = {"model": "mnist_cnn", "num_classes": 10, "bf16": bool(args.get("bf16")),
+                    "features": list(args.get("features", (32, 64))),
+                    "dense": args.get("dense", 256)}
+    model = mnist.build_mnist(model_config)
+    params = mnist.init_params(model, jax.random.PRNGKey(args.get("seed", 0)))
+    optimizer = optax.sgd(args.get("lr", 0.05), momentum=0.9)
+
+    mesh = ctx.make_mesh(dp=-1)
+    state = TrainState.create(params, optimizer)
+    # Whole-job restart picks up the latest checkpoint (the reference's
+    # recovery contract: fail-fast + restart from checkpoint, SURVEY.md §5.3).
+    if args.get("model_dir"):
+        restored = CheckpointManager(args["model_dir"]).restore_latest({"params": state.params})
+        if restored is not None:
+            tree, step_no = restored
+            state = state._replace(params=tree["params"], step=state.step + step_no)
+    state = replicate(state, mesh)
+    step = make_train_step(mnist.make_loss_fn(model), optimizer)
+
+    is_chief = ctx.executor_id == 0
+    writer = None
+    if is_chief and args.get("log_dir"):
+        writer = SummaryWriter(os.path.join(args["log_dir"], "train"))
+
+    feed = ctx.get_data_feed(train_mode=True)
+    last_metrics = {}
+    for batch, _n in make_batch_iterator(
+        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx
+    ):
+        state, metrics = step(state, batch)
+        step_no = int(state.step)
+        if writer and step_no % args.get("log_every", 10) == 0:
+            writer.add_scalars({k: float(v) for k, v in metrics.items()}, step_no)
+        last_metrics = metrics
+
+    if is_chief:
+        if args.get("model_dir"):
+            CheckpointManager(args["model_dir"]).save(int(state.step), {"params": state.params})
+        if args.get("export_dir"):
+            export_bundle(args["export_dir"], state.params, model_config)
+        if writer:
+            for k, v in last_metrics.items():
+                writer.add_scalar(f"final/{k}", float(v), int(state.step))
+            writer.close()
+
+
+def inference_fun(args, ctx):
+    """Streaming inference map_fun (parity config 5's shape): items in,
+    predictions out — ordered, exactly-count (SURVEY.md §3.3)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.checkpoint import load_bundle_cached
+    from tensorflowonspark_tpu.models import mnist, registry
+
+    params, _config, apply_fn = load_bundle_cached(args["export_dir"], registry.build_apply)
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        items = feed.next_batch(args.get("batch_size", 64))
+        if not items:
+            continue
+        batch = mnist.batch_to_arrays([(i, 0) if not isinstance(i, tuple) else i for i in items])
+        logits = apply_fn(params, batch["image"])
+        preds = np.asarray(jax.device_get(logits)).argmax(-1)
+        feed.batch_results([int(p) for p in preds[: len(items)]])
+
+
+def main() -> None:
+    import tensorflowonspark_tpu as tos
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-executors", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--samples", type=int, default=2000)
+    p.add_argument("--partitions", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--model-dir", default="/tmp/mnist_model")
+    p.add_argument("--export-dir", default="/tmp/mnist_export")
+    p.add_argument("--log-dir", default="/tmp/mnist_logs")
+    p.add_argument("--tensorboard", action="store_true")
+    a = p.parse_args()
+
+    args = {
+        "batch_size": a.batch_size, "lr": a.lr, "model_dir": a.model_dir,
+        "export_dir": a.export_dir, "log_dir": a.log_dir,
+    }
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(a.samples), a.partitions)
+    cluster = tos.run(
+        main_fun, args, num_executors=a.num_executors,
+        input_mode=tos.InputMode.STREAMING, tensorboard=a.tensorboard,
+        log_dir=a.log_dir,
+    )
+    cluster.train(data, num_epochs=a.epochs)
+    cluster.shutdown()
+    print(f"training done; model in {a.model_dir}, bundle in {a.export_dir}")
+
+
+if __name__ == "__main__":
+    main()

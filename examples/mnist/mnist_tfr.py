@@ -1,0 +1,102 @@
+"""Distributed MNIST, direct (framework-reads-files) input mode — parity
+config 2 (reference ``examples/mnist/tf/mnist_dist.py``: InputMode.TENSORFLOW
+reading TFRecords from HopsFS, BASELINE.json:8).
+
+Each node reads the TFRecord shards assigned to it (strided by executor id —
+the same shard-ownership scheme ``tf.data`` auto-sharding gave the
+reference), trains the shared sync-SPMD step, and agrees on a global stop
+via control-plane consensus.
+
+Usage: first write shards with ``prepare_data()``, then run the cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:  # allow running straight from a checkout
+    sys.path.insert(0, _REPO)
+
+
+def prepare_data(output_dir: str, samples: int = 2000, partitions: int = 8) -> None:
+    """Write synthetic MNIST TFRecord shards (stand-in for the reference's
+    mnist_data_setup.py, which downloaded and converted the real set)."""
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.data import PartitionedDataset
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    rows = [{"image": img.ravel().tolist(), "label": label} for img, label in synthetic_mnist(samples)]
+    dfutil.save_as_tfrecords(PartitionedDataset.from_iterable(rows, partitions), output_dir)
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.feeding import IteratorFeed
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.parallel.dp import (
+        TrainState, make_batch_iterator, make_train_step, replicate,
+    )
+
+    model_config = {"model": "mnist_cnn", "num_classes": 10,
+                    "features": list(args.get("features", (32, 64))),
+                    "dense": args.get("dense", 256)}
+    model = mnist.build_mnist(model_config)
+    params = mnist.init_params(model, jax.random.PRNGKey(args.get("seed", 0)))
+    optimizer = optax.sgd(args.get("lr", 0.05), momentum=0.9)
+    mesh = ctx.make_mesh(dp=-1)
+    state = replicate(TrainState.create(params, optimizer), mesh)
+    step = make_train_step(mnist.make_loss_fn(model), optimizer)
+
+    # Shard ownership: files strided over data nodes by executor id (the
+    # tf.data auto-shard analogue the reference relied on).
+    my_shards = dfutil.shard_files(args["data_dir"])[ctx.executor_id :: ctx.num_data_nodes]
+    schema = dfutil.read_schema(args["data_dir"])
+
+    def samples():
+        for _epoch in range(args.get("epochs", 1)):
+            for shard in my_shards:
+                for row in dfutil.read_shard(shard, schema):
+                    yield (np.asarray(row["image"], np.float32).reshape(28, 28, 1), int(row["label"]))
+
+    feed = IteratorFeed(samples())
+    for batch, _n in make_batch_iterator(
+        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx
+    ):
+        state, metrics = step(state, batch)
+
+    if ctx.executor_id == 0 and args.get("export_dir"):
+        export_bundle(args["export_dir"], state.params, model_config)
+
+
+def main() -> None:
+    import tensorflowonspark_tpu as tos
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default="/tmp/mnist_tfr")
+    p.add_argument("--export-dir", default="/tmp/mnist_export")
+    p.add_argument("--num-executors", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--prepare", action="store_true", help="write synthetic shards first")
+    a = p.parse_args()
+
+    if a.prepare:
+        prepare_data(a.data_dir)
+    args = {"data_dir": a.data_dir, "export_dir": a.export_dir,
+            "epochs": a.epochs, "batch_size": a.batch_size}
+    cluster = tos.run(main_fun, args, num_executors=a.num_executors,
+                      input_mode=tos.InputMode.DIRECT)
+    cluster.shutdown(timeout=600)
+    print(f"trained from {a.data_dir}; bundle in {a.export_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Checkpointing + model export — the SavedModel/HopsFS path plumbing.
+
+Reference behaviour (SURVEY.md §5.4): checkpointing is delegated to TF +
+HDFS/HopsFS; TFoS contributes path resolution (``TFNode.hdfs_path``) and a
+SavedModel export used by the inference side (``TFNode.export_saved_model``
+``TFNode.py:~160-230``; ``pipeline.TFModel`` loads it).
+
+TPU-native: Orbax for sharded/async checkpoints of pytrees, plus a
+"bundle" export format for inference — a directory holding the params
+checkpoint and a JSON model config, the pytree+apply-fn analogue of a
+SavedModel.  ``hdfs://``/``hopsfs://`` URIs resolve through
+``utils.paths.register_fs_root`` ("HopsFS checkpointing stays unchanged",
+BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, tree: Any, force: bool = True) -> str:
+    """Save a pytree checkpoint to a (possibly hdfs://-mapped) path."""
+    local = os.path.abspath(resolve_uri(path))
+    _checkpointer().save(local, tree, force=force)
+    return local
+
+
+def restore_checkpoint(path: str, target: Any | None = None) -> Any:
+    """Restore a pytree; ``target`` (a matching pytree) restores dtypes/shapes
+    and device placement exactly."""
+    local = os.path.abspath(resolve_uri(path))
+    import orbax.checkpoint as ocp
+
+    if target is not None:
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        return _checkpointer().restore(local, restore_args=restore_args)
+    return _checkpointer().restore(local)
+
+
+def latest_step_dir(model_dir: str) -> str | None:
+    """Find the latest ``step_N`` checkpoint under ``model_dir``."""
+    local = resolve_uri(model_dir)
+    if not os.path.isdir(local):
+        return None
+    steps = []
+    for name in os.listdir(local):
+        if name.startswith("step_") and name[5:].isdigit():
+            steps.append(int(name[5:]))
+    if not steps:
+        return None
+    return os.path.join(model_dir, f"step_{max(steps)}")
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints under one model_dir (keeps the newest K)."""
+
+    def __init__(self, model_dir: str, max_to_keep: int = 3):
+        self.model_dir = model_dir
+        self.max_to_keep = max_to_keep
+        os.makedirs(resolve_uri(model_dir), exist_ok=True)
+
+    def save(self, step: int, tree: Any) -> str:
+        path = os.path.join(self.model_dir, f"step_{int(step)}")
+        save_checkpoint(path, tree)
+        self._gc()
+        return path
+
+    def restore_latest(self, target: Any | None = None) -> tuple[Any, int] | None:
+        path = latest_step_dir(self.model_dir)
+        if path is None:
+            return None
+        step = int(os.path.basename(path)[5:])
+        return restore_checkpoint(path, target), step
+
+    def _gc(self) -> None:
+        local = resolve_uri(self.model_dir)
+        steps = sorted(
+            int(n[5:]) for n in os.listdir(local) if n.startswith("step_") and n[5:].isdigit()
+        )
+        for s in steps[: -self.max_to_keep]:
+            import shutil
+
+            shutil.rmtree(os.path.join(local, f"step_{s}"), ignore_errors=True)
+
+
+# -- inference bundles (SavedModel analogue) ---------------------------------
+
+def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
+    """Export params + config for serving (reference ``export_saved_model``).
+
+    ``model_config`` must contain everything needed to rebuild the apply fn
+    (e.g. ``{"model": "mnist_cnn", "num_classes": 10}``); the model registry
+    in ``models/`` resolves it at load time.
+    """
+    local = resolve_uri(export_dir)
+    os.makedirs(local, exist_ok=True)
+    save_checkpoint(os.path.join(export_dir, "params"), params)
+    with open(os.path.join(local, "bundle.json"), "w") as f:
+        json.dump(model_config, f, indent=2, sort_keys=True)
+    return local
+
+
+def load_bundle(export_dir: str) -> tuple[Any, dict]:
+    """Load an exported bundle -> (params, model_config)."""
+    local = resolve_uri(export_dir)
+    with open(os.path.join(local, "bundle.json")) as f:
+        config = json.load(f)
+    params = restore_checkpoint(os.path.join(export_dir, "params"))
+    return params, config
+
+
+_BUNDLE_CACHE: dict[str, tuple[Any, dict, Callable]] = {}
+
+
+def load_bundle_cached(export_dir: str, build_apply: Callable[[dict], Callable]) -> tuple[Any, dict, Callable]:
+    """Per-process cached bundle load (reference ``pipeline._run_model``'s
+    per-executor singleton SavedModel load, ``pipeline.py:~600-700``)."""
+    key = os.path.abspath(resolve_uri(export_dir))
+    if key not in _BUNDLE_CACHE:
+        params, config = load_bundle(export_dir)
+        _BUNDLE_CACHE[key] = (params, config, build_apply(config))
+    return _BUNDLE_CACHE[key]

@@ -172,9 +172,17 @@ class DataServer:
                 return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
             return ("ok",)
         if op == "eof":
-            # shutdown marker: must always land, even if the consumer stalled
-            # with a full queue — never let the driver's teardown hang here.
-            _force_put(self.queues.get_queue(msg[1]), EndOfFeed())
+            # Shutdown marker.  A full queue usually just means backpressure
+            # (consumer alive but behind) — wait for space so no queued sample
+            # is lost; force-discard only if the consumer is truly stalled,
+            # so the driver's teardown can never hang here.
+            q = self.queues.get_queue(msg[1])
+            try:
+                q.put(EndOfFeed(), block=True, timeout=self.feed_timeout)
+            except queue.Full:
+                logger.warning("consumer stalled with full queue %r; forcing EndOfFeed "
+                               "(discarding a queued item)", msg[1])
+                _force_put(q, EndOfFeed())
             return ("ok",)
         if op == "infer":
             _, qname_in, qname_out, items = msg

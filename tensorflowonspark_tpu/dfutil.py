@@ -148,22 +148,37 @@ def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema 
     return schema
 
 
+def shard_files(input_dir: str) -> list[str]:
+    """List the TFRecord shard files of a dataset directory, sorted."""
+    local = resolve_uri(input_dir)
+    files = sorted(f for f in _glob.glob(os.path.join(local, "part-*")) if not f.endswith(".json"))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards under {local}")
+    return files
+
+
+def read_schema(input_dir: str) -> Schema | None:
+    """Load the ``_schema.json`` stored next to the shards, if present."""
+    path = os.path.join(resolve_uri(input_dir), "_schema.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return Schema.from_json(f.read())
+
+
+def read_shard(path: str, schema: Schema | None = None,
+               binary_features: set | None = None) -> Iterator[dict]:
+    """Iterate one shard file as rows."""
+    for rec in tfrecord.read_records(path):
+        yield from_example(rec, schema, binary_features)
+
+
 def load_tfrecords(input_dir: str, binary_features: set | None = None) -> tuple[PartitionedDataset, Schema | None]:
     """Load a TFRecord directory as a PartitionedDataset of rows (reference
     ``loadTFRecords``, ``dfutil.py:~60-100``); one partition per shard file."""
-    input_dir = resolve_uri(input_dir)
-    schema = None
-    schema_path = os.path.join(input_dir, "_schema.json")
-    if os.path.exists(schema_path):
-        with open(schema_path) as f:
-            schema = Schema.from_json(f.read())
-
-    files = sorted(f for f in _glob.glob(os.path.join(input_dir, "part-*")) if not f.endswith(".json"))
-    if not files:
-        raise FileNotFoundError(f"no TFRecord shards under {input_dir}")
-
-    def reader(path: str, _schema=schema) -> Iterator[dict]:
-        for rec in tfrecord.read_records(path):
-            yield from_example(rec, _schema, binary_features)
-
-    return PartitionedDataset([(lambda f=f: reader(f)) for f in files]), schema
+    schema = read_schema(input_dir)
+    files = shard_files(input_dir)
+    return (
+        PartitionedDataset([(lambda f=f: read_shard(f, schema, binary_features)) for f in files]),
+        schema,
+    )

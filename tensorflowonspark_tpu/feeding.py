@@ -62,6 +62,30 @@ class FeedQueues:
             return self._state.get(key)
 
 
+class IteratorFeed:
+    """Adapt a plain Python iterator to the DataFeed consumption protocol
+    (``next_batch``/``should_stop``), so direct-input-mode code (framework
+    reads files itself) can reuse the same batch/consensus machinery as the
+    streaming mode (``parallel.dp.make_batch_iterator``)."""
+
+    def __init__(self, iterable):
+        self._it = iter(iterable)
+        self.done_feeding = False
+
+    def next_batch(self, batch_size: int) -> list:
+        batch: list = []
+        while len(batch) < batch_size:
+            try:
+                batch.append(next(self._it))
+            except StopIteration:
+                self.done_feeding = True
+                break
+        return batch
+
+    def should_stop(self) -> bool:
+        return self.done_feeding
+
+
 class DataFeed:
     """User-facing feed API inside ``map_fun`` (reference ``TFNode.DataFeed``).
 

@@ -1,0 +1,44 @@
+"""Model registry: name → builder, so exported bundles can be re-instantiated
+for inference from their JSON config alone (the SavedModel-signature
+analogue used by ``checkpoint.load_bundle_cached`` and the pipeline layer)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(builder: Callable):
+        _REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def build(config: dict):
+    """Instantiate a model from a bundle config ``{"model": name, ...}``."""
+    name = config.get("model")
+    if name not in _REGISTRY:
+        # model modules self-register on import; pull them in lazily
+        from tensorflowonspark_tpu.models import mnist  # noqa: F401
+
+        try:
+            from tensorflowonspark_tpu.models import resnet  # noqa: F401
+            from tensorflowonspark_tpu.models import inception  # noqa: F401
+            from tensorflowonspark_tpu.models import wide_deep  # noqa: F401
+            from tensorflowonspark_tpu.models import transformer  # noqa: F401
+        except ImportError:
+            pass
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](config)
+
+
+def build_apply(config: dict) -> Callable:
+    """Build a jitted ``apply(params, x)`` for a bundle config."""
+    import jax
+
+    model = build(config)
+    return jax.jit(lambda params, x: model.apply({"params": params}, x))

@@ -89,7 +89,10 @@ int64_t tos_scan_records(const uint8_t* buf, size_t n, int verify,
       *consumed = pos;
       return -1;
     }
-    if (n - pos - 12 < len + 4) break;  // incomplete record
+    // Overflow-safe incomplete-record check: `len + 4` could wrap for a
+    // corrupt length field when verify=0, turning an OOB read into a crash.
+    const uint64_t avail = n - pos - 12;
+    if (len > avail || avail - len < 4) break;  // incomplete record
     const uint8_t* data = buf + pos + 12;
     uint32_t data_crc = le32(data + len);
     if (verify && masked(tos_crc32c(data, len, 0)) != data_crc) {

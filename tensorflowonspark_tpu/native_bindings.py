@@ -13,7 +13,7 @@ import os
 import subprocess
 import tempfile
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "tfrecord_codec.cc")
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native", "tfrecord_codec.cc")
 _CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native_build")
 
 

@@ -1,0 +1,151 @@
+"""Sync SPMD data-parallel training — the ParameterServer/MWMS replacement.
+
+Reference (SURVEY.md §2.3): data parallelism via async parameter servers
+(``tf.train.replica_device_setter``) or ``MultiWorkerMirroredStrategy``
+(NCCL all-reduce), both configured through the ``TF_CONFIG`` env var TFoS
+wrote.  TPU-native replacement (BASELINE.json:5): one jitted SPMD program
+over a named mesh; the gradient all-reduce is emitted by XLA over ICI from
+sharding annotations — there are no server objects, no strategy classes, and
+no NCCL.
+
+Usage::
+
+    mesh = make_mesh(dp=-1)
+    state = replicate(TrainState.create(params, optax.adam(1e-3)), mesh)
+    step = make_train_step(loss_fn, optimizer)
+    for batch in feed:
+        state, metrics = step(state, shard_batch(mesh, batch))
+
+``loss_fn(params, batch) -> (loss, aux_metrics)`` is the user contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu.parallel.mesh import batch_sharding, replicated
+
+
+class TrainState(NamedTuple):
+    """Minimal functional train state (params + optimizer state + step)."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, optimizer: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def replicate(tree: Any, mesh) -> Any:
+    """Place a pytree fully-replicated on the mesh (pure data parallelism).
+
+    Copies through host memory on purpose: ``jax.device_put`` may alias the
+    source buffer as one replica, and the train step *donates* its state —
+    donation through an alias would silently delete the caller's original
+    arrays.  Host-staging guarantees fresh device buffers and also accepts
+    sources committed to any device subset (e.g. an orbax restore on device
+    0).  This runs once at job start; the copy cost is irrelevant.
+    """
+    import numpy as np
+
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sharding), tree)
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any], tuple[jax.Array, dict]],
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
+    """Build the jitted SPMD train step.
+
+    The batch arrives sharded over the ``(dp, fsdp)`` axes and params arrive
+    replicated (or fsdp-sharded); XLA partitions the forward/backward and
+    inserts the gradient all-reduce over ICI automatically.  Metrics come
+    back replicated scalars (already globally reduced, since the loss is a
+    mean over the global batch).
+    """
+
+    def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    # Shardings are inferred from operand placement (replicated params +
+    # dp-sharded batch ⇒ XLA partitions the step and all-reduces grads).
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    apply_fn: Callable[[Any, Any], jax.Array],
+) -> Callable[[Any, Any], jax.Array]:
+    """Jitted inference step: params + sharded inputs -> outputs."""
+    return jax.jit(apply_fn)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over the (global) batch."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def make_batch_iterator(
+    feed,
+    batch_size: int,
+    to_arrays: Callable[[list], Any],
+    mesh=None,
+    ctx=None,
+    pad_to_batch: bool = True,
+):
+    """Drain a DataFeed into device-ready, mesh-sharded batches.
+
+    Handles the sync-SPMD end-of-data problem (SURVEY.md §7.3-1): partial
+    final batches are padded (repeating the last sample) and, when ``ctx`` is
+    given, a control-plane ``all_done`` consensus decides when *all* hosts
+    stop — no host may exit the step loop early.
+    """
+    from tensorflowonspark_tpu.parallel.mesh import shard_batch
+
+    exhausted = False  # feed hit end-of-feed: NEVER call next_batch again
+    dry = False        # exhausted and nothing left to yield
+    while True:
+        items: list = []
+        if not dry:
+            if not exhausted:
+                items = feed.next_batch(batch_size)
+                # EndOfFeed can arrive mid-batch: a non-empty partial batch
+                # with should_stop() set must still be trained on, but one
+                # more next_batch() call would block forever.
+                exhausted = feed.should_stop()
+            dry = exhausted and not items
+        if ctx is not None:
+            # One consensus round per step: active hosts vote False once per
+            # batch; dry hosts keep voting True (without touching the feed)
+            # until everyone is dry, so no host exits the SPMD loop early.
+            if ctx.all_done(dry):
+                return
+            if dry:
+                continue
+        elif dry:
+            return
+        if not items:
+            continue
+        n = len(items)
+        if pad_to_batch and n < batch_size:
+            items = list(items) + [items[-1]] * (batch_size - n)
+        batch = to_arrays(items)
+        if mesh is not None:
+            batch = shard_batch(mesh, batch)
+        yield batch, n

@@ -6,30 +6,35 @@ logic, a virtual 8-device CPU platform
 (``--xla_force_host_platform_device_count=8``), since multi-chip TPU hardware
 is not available here.
 
-This environment force-registers a TPU PJRT plugin from ``sitecustomize`` at
-interpreter start, which overrides ``JAX_PLATFORMS=cpu`` even when set before
-``import jax``.  Tests must never touch the (single, exclusive) TPU — and
-spawned node processes would each try to claim it too.  So on first import we
-re-exec the test process once with a cleaned environment; node child
-processes inherit it.
+This environment force-registers an exclusive single-TPU PJRT plugin from
+``sitecustomize`` (keyed on ``PALLAS_AXON_POOL_IPS``) which overrides
+``JAX_PLATFORMS=cpu``.  Tests must never grab that TPU:
+
+- this process: the plugin forces ``jax_platforms="axon,cpu"`` through
+  jax.config, so we override it back to ``cpu`` the same way (backends
+  initialize lazily, so doing this at conftest import is early enough —
+  pytest plugins may have *imported* jax already, which is harmless);
+- spawned node processes: they inherit os.environ, so clearing
+  ``PALLAS_AXON_POOL_IPS`` disables the sitecustomize registration there and
+  ``JAX_PLATFORMS=cpu`` selects the CPU platform outright.
 """
 
 import os
-import sys
 
-if os.environ.get("_TOS_TEST_CLEAN") != "1":
-    if "jax" in sys.modules:  # too late to fix the platform; proceed as-is
-        sys.stderr.write("conftest: jax already imported; cannot force CPU platform\n")
-    else:
-        env = dict(os.environ)
-        env["_TOS_TEST_CLEAN"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        # An empty value disables the sitecustomize TPU-plugin registration
-        # in this process and every spawned node process.
-        env["PALLAS_AXON_POOL_IPS"] = ""
-        flags = env.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-        os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
-
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("TPU_FRAMEWORK_TEST", "1")
+
+import jax  # noqa: E402
+
+if jax.config.jax_platforms != "cpu":
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():  # pragma: no cover - plugin ordering edge
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    jax.config.update("jax_platforms", "cpu")

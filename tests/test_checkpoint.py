@@ -1,0 +1,63 @@
+"""Checkpoint/export tests (reference delegates to TF+HopsFS, SURVEY.md §5.4;
+here Orbax + bundle export, with hdfs:// scheme mapping)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import checkpoint as ckpt
+from tensorflowonspark_tpu.utils.paths import register_fs_root
+
+
+def tree():
+    return {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": jnp.ones(())}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    path = str(tmp_path / "c1")
+    ckpt.save_checkpoint(path, tree())
+    out = ckpt.restore_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree()["w"]))
+
+
+def test_manager_keeps_newest(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "m"), max_to_keep=2)
+    for s in [1, 5, 9]:
+        mgr.save(s, {"s": jnp.asarray(s)})
+    restored, step = mgr.restore_latest()
+    assert step == 9 and int(restored["s"]) == 9
+    import os
+
+    kept = sorted(os.listdir(tmp_path / "m"))
+    assert kept == ["step_5", "step_9"]
+
+
+def test_hdfs_scheme(tmp_path):
+    register_fs_root("hopsfs", str(tmp_path))
+    mgr = ckpt.CheckpointManager("hopsfs://nn/models/x")
+    mgr.save(3, tree())
+    restored, step = mgr.restore_latest()
+    assert step == 3
+
+
+def test_bundle_roundtrip(tmp_path):
+    config = {"model": "mnist_cnn", "num_classes": 10, "features": [4, 8], "dense": 16}
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.build_mnist(config)
+    import jax
+
+    params = mnist.init_params(model, jax.random.PRNGKey(0))
+    ckpt.export_bundle(str(tmp_path / "bundle"), params, config)
+
+    from tensorflowonspark_tpu.models import registry
+
+    params2, config2, apply_fn = ckpt.load_bundle_cached(str(tmp_path / "bundle"), registry.build_apply)
+    assert config2 == config
+    x = np.zeros((2, 28, 28, 1), np.float32)
+    out = apply_fn(params2, x)
+    assert out.shape == (2, 10)
+    # cache hit returns the same objects
+    again = ckpt.load_bundle_cached(str(tmp_path / "bundle"), registry.build_apply)
+    assert again[2] is apply_fn

@@ -1,0 +1,73 @@
+"""End-to-end MNIST parity tests: the reference's examples-as-tests
+(SURVEY.md §4 'Example-as-test'), covering parity configs 1 (streaming), 2
+(direct TFRecords) and the bundle-export → streaming-inference loop.
+
+Real node processes + real JAX (CPU); tiny model/shapes to fit this box.
+"""
+
+import os
+import sys
+
+import pytest
+
+import tensorflowonspark_tpu as tos
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples", "mnist")
+if EXAMPLES not in sys.path:
+    sys.path.insert(0, EXAMPLES)
+
+import mnist_dist  # noqa: E402
+import mnist_tfr  # noqa: E402
+
+TINY = {"features": [4, 8], "dense": 16, "batch_size": 16, "lr": 0.05}
+
+
+@pytest.mark.slow
+def test_streaming_train_then_inference(tmp_path):
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    args = {**TINY, "model_dir": str(tmp_path / "model"), "export_dir": str(tmp_path / "export"),
+            "log_dir": str(tmp_path / "logs")}
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(320), 4)
+
+    cluster = tos.run(mnist_dist.main_fun, args, num_executors=2,
+                      input_mode=tos.InputMode.STREAMING,
+                      log_dir=str(tmp_path / "nodelogs"), reservation_timeout=120)
+    cluster.train(data, num_epochs=2)
+    cluster.shutdown(timeout=300)
+
+    # checkpoint + bundle landed
+    assert os.path.isdir(tmp_path / "model")
+    assert os.path.exists(tmp_path / "export" / "bundle.json")
+    # tensorboard events written by the chief
+    import glob
+
+    assert glob.glob(str(tmp_path / "logs" / "train" / "events.out.tfevents.*"))
+
+    # streaming inference over the exported bundle: ordered, exactly-count
+    infer_args = {**TINY, "export_dir": str(tmp_path / "export")}
+    c2 = tos.run(mnist_dist.inference_fun, infer_args, num_executors=2,
+                 input_mode=tos.InputMode.STREAMING,
+                 log_dir=str(tmp_path / "nodelogs2"), reservation_timeout=120)
+    samples = synthetic_mnist(64, seed=9)
+    preds = c2.inference([list(p) for p in
+                          (samples[:20], samples[20:45], samples[45:])])
+    c2.shutdown(timeout=300)
+    assert len(preds) == 64
+    assert all(isinstance(p, int) and 0 <= p < 10 for p in preds)
+    # the synthetic task is learnable: most predictions should be right
+    labels = [l for _, l in samples]
+    acc = sum(p == l for p, l in zip(preds, labels)) / len(labels)
+    assert acc > 0.5, f"accuracy {acc}"
+
+
+@pytest.mark.slow
+def test_direct_tfrecord_train(tmp_path):
+    data_dir = str(tmp_path / "tfr")
+    mnist_tfr.prepare_data(data_dir, samples=320, partitions=4)
+    args = {**TINY, "data_dir": data_dir, "export_dir": str(tmp_path / "export"), "epochs": 1}
+    cluster = tos.run(mnist_tfr.main_fun, args, num_executors=2,
+                      input_mode=tos.InputMode.DIRECT,
+                      log_dir=str(tmp_path / "nodelogs"), reservation_timeout=120)
+    cluster.shutdown(timeout=300)
+    assert os.path.exists(tmp_path / "export" / "bundle.json")
