@@ -5,7 +5,7 @@ into each node's DataFeed; nodes run a sync SPMD train step over their local
 mesh, with control-plane ``all_done`` consensus replacing the reference's
 tolerance for uneven async-PS partition exhaustion (SURVEY.md §7.3-1).
 
-Run directly:  python mnist_dist.py --num-executors 2 --steps-log 10
+Run directly:  python mnist_dist.py --num-executors 2 --epochs 1
 """
 
 from __future__ import annotations

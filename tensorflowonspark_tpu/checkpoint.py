@@ -47,18 +47,21 @@ def restore_checkpoint(path: str, target: Any | None = None) -> Any:
     return _checkpointer().restore(local)
 
 
-def latest_step_dir(model_dir: str) -> str | None:
-    """Find the latest ``step_N`` checkpoint under ``model_dir``."""
+def _step_dirs(model_dir: str) -> list[tuple[int, str]]:
+    """Sorted (step, uri_path) pairs for ``step_N`` dirs under model_dir."""
     local = resolve_uri(model_dir)
     if not os.path.isdir(local):
-        return None
-    steps = []
-    for name in os.listdir(local):
-        if name.startswith("step_") and name[5:].isdigit():
-            steps.append(int(name[5:]))
-    if not steps:
-        return None
-    return os.path.join(model_dir, f"step_{max(steps)}")
+        return []
+    steps = sorted(
+        int(n[5:]) for n in os.listdir(local) if n.startswith("step_") and n[5:].isdigit()
+    )
+    return [(s, os.path.join(model_dir, f"step_{s}")) for s in steps]
+
+
+def latest_step_dir(model_dir: str) -> str | None:
+    """Find the latest ``step_N`` checkpoint under ``model_dir``."""
+    dirs = _step_dirs(model_dir)
+    return dirs[-1][1] if dirs else None
 
 
 class CheckpointManager:
@@ -76,21 +79,17 @@ class CheckpointManager:
         return path
 
     def restore_latest(self, target: Any | None = None) -> tuple[Any, int] | None:
-        path = latest_step_dir(self.model_dir)
-        if path is None:
+        dirs = _step_dirs(self.model_dir)
+        if not dirs:
             return None
-        step = int(os.path.basename(path)[5:])
+        step, path = dirs[-1]
         return restore_checkpoint(path, target), step
 
     def _gc(self) -> None:
-        local = resolve_uri(self.model_dir)
-        steps = sorted(
-            int(n[5:]) for n in os.listdir(local) if n.startswith("step_") and n[5:].isdigit()
-        )
-        for s in steps[: -self.max_to_keep]:
-            import shutil
+        import shutil
 
-            shutil.rmtree(os.path.join(local, f"step_{s}"), ignore_errors=True)
+        for _, path in _step_dirs(self.model_dir)[: -self.max_to_keep]:
+            shutil.rmtree(resolve_uri(path), ignore_errors=True)
 
 
 # -- inference bundles (SavedModel analogue) ---------------------------------

@@ -173,12 +173,14 @@ class DataServer:
             return ("ok",)
         if op == "eof":
             # Shutdown marker.  A full queue usually just means backpressure
-            # (consumer alive but behind) — wait for space so no queued sample
-            # is lost; force-discard only if the consumer is truly stalled,
-            # so the driver's teardown can never hang here.
+            # (consumer alive but behind) — wait briefly for space so no
+            # queued sample is lost; force-discard if the consumer looks
+            # stalled.  Deliberately NOT feed_timeout: shutdown sends EOFs
+            # serially per node/queue and must never stack near-10-minute
+            # waits behind a hung consumer.
             q = self.queues.get_queue(msg[1])
             try:
-                q.put(EndOfFeed(), block=True, timeout=self.feed_timeout)
+                q.put(EndOfFeed(), block=True, timeout=min(5.0, self.feed_timeout))
             except queue.Full:
                 logger.warning("consumer stalled with full queue %r; forcing EndOfFeed "
                                "(discarding a queued item)", msg[1])

@@ -21,16 +21,15 @@ def build(config: dict):
     """Instantiate a model from a bundle config ``{"model": name, ...}``."""
     name = config.get("model")
     if name not in _REGISTRY:
-        # model modules self-register on import; pull them in lazily
-        from tensorflowonspark_tpu.models import mnist  # noqa: F401
+        # model modules self-register on import; pull them in lazily, each on
+        # its own so one missing family doesn't skip the rest
+        import importlib
 
-        try:
-            from tensorflowonspark_tpu.models import resnet  # noqa: F401
-            from tensorflowonspark_tpu.models import inception  # noqa: F401
-            from tensorflowonspark_tpu.models import wide_deep  # noqa: F401
-            from tensorflowonspark_tpu.models import transformer  # noqa: F401
-        except ImportError:
-            pass
+        for mod in ("mnist", "resnet", "inception", "wide_deep", "transformer"):
+            try:
+                importlib.import_module(f"tensorflowonspark_tpu.models.{mod}")
+            except ImportError:
+                pass
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; registered: {sorted(_REGISTRY)}")
     return _REGISTRY[name](config)

@@ -84,6 +84,44 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+class BNTrainState(NamedTuple):
+    """Train state for models with mutable normalization stats (ResNet/BN)."""
+
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, batch_stats: Any,
+               optimizer: optax.GradientTransformation) -> "BNTrainState":
+        return cls(params=params, batch_stats=batch_stats,
+                   opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def make_bn_train_step(
+    loss_fn: Callable[[Any, Any, Any], tuple[jax.Array, tuple[Any, dict]]],
+    optimizer: optax.GradientTransformation,
+    donate: bool = True,
+) -> Callable[[BNTrainState, Any], tuple[BNTrainState, dict]]:
+    """Jitted SPMD train step for BN models.
+
+    ``loss_fn(params, batch_stats, batch) -> (loss, (new_batch_stats, aux))``.
+    Under GSPMD the BN batch reductions over the dp-sharded axis compile to
+    global cross-replica reductions — sync BatchNorm for free.
+    """
+
+    def step(state: BNTrainState, batch: Any) -> tuple[BNTrainState, dict]:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (batch_stats, aux)), grads = grad_fn(state.params, state.batch_stats, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return BNTrainState(params, batch_stats, opt_state, state.step + 1), metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(
     apply_fn: Callable[[Any, Any], jax.Array],
 ) -> Callable[[Any, Any], jax.Array]:
@@ -118,6 +156,11 @@ def make_batch_iterator(
     """
     from tensorflowonspark_tpu.parallel.mesh import shard_batch
 
+    if getattr(feed, "input_mapping", None):
+        raise ValueError(
+            "make_batch_iterator needs row-shaped batches; construct the "
+            "DataFeed without input_mapping and map columns in to_arrays"
+        )
     exhausted = False  # feed hit end-of-feed: NEVER call next_batch again
     dry = False        # exhausted and nothing left to yield
     while True:

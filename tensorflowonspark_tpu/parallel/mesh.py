@@ -96,6 +96,43 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def fsdp_shardings(mesh: Mesh, tree):
+    """Per-leaf NamedShardings sharding params over the ``fsdp`` axis (ZeRO-3
+    style: each leaf is split on its largest fsdp-divisible dimension; XLA
+    inserts the all-gather before use and the reduce-scatter on gradients).
+
+    Leaves too small to split (or with no divisible dim) stay replicated —
+    that is the correct GSPMD idiom, not a fallback: tiny biases/BN scales
+    cost nothing to replicate and sharding them would only add latency.
+    """
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("fsdp", 1)
+
+    def leaf_sharding(x) -> NamedSharding:
+        if axis_size == 1 or not hasattr(x, "shape") or x.ndim == 0:
+            return replicated(mesh)
+        dims = sorted(range(x.ndim), key=lambda d: x.shape[d], reverse=True)
+        for d in dims:
+            if x.shape[d] % axis_size == 0 and x.shape[d] >= axis_size:
+                pspec = [None] * x.ndim
+                pspec[d] = "fsdp"
+                return NamedSharding(mesh, P(*pspec))
+        return replicated(mesh)
+
+    return jax.tree.map(leaf_sharding, tree)
+
+
+def shard_tree(mesh: Mesh, tree, shardings=None):
+    """Place a pytree on the mesh under the given (or fsdp-derived) shardings.
+
+    Stages through host memory for the same donation-safety reason as
+    ``dp.replicate`` (fresh buffers; sources may live on any device subset).
+    """
+    shardings = shardings if shardings is not None else fsdp_shardings(mesh, tree)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(np.asarray(x), s), tree, shardings
+    )
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host batch onto the mesh, sharded along the leading axis."""
     return jax.tree.map(
