@@ -27,6 +27,15 @@ __version__ = "0.1.0"
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
 from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
 from tensorflowonspark_tpu.data import PartitionedDataset  # noqa: F401
+from tensorflowonspark_tpu.pipeline import (  # noqa: F401
+    Namespace,
+    TPUEstimator,
+    TPUModel,
+    TPUParams,
+)
 
 # Drop-in style aliases for users coming from TensorFlowOnSpark.
 TFCluster = TPUCluster
+TFEstimator = TPUEstimator
+TFModel = TPUModel
+TFParams = TPUParams

@@ -1,0 +1,119 @@
+"""Wide-and-deep for Criteo-style CTR data — parity config 4
+(BASELINE.json:10: "Spark ML Pipeline TFEstimator/TFModel, wide-and-deep on
+Criteo"; reference ``examples/criteo/``).
+
+TPU-native design: one ``[B, 13 + 26]`` feature matrix per batch — 13
+numeric columns and 26 categorical columns (already integerized; hashed
+mod ``vocab_size`` here, the in-graph equivalent of the reference's
+feature-column hash buckets).  The wide path is a linear model over the
+one-hot categorical space implemented as embedding-gathers (a [B,26]
+gather, not a [B, vocab] one-hot matmul — HBM-friendly); the deep path is
+embeddings + MLP, whose matmuls ride the MXU in bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu.models.registry import register
+
+NUM_NUMERIC = 13
+NUM_CATEGORICAL = 26
+
+
+class WideDeep(nn.Module):
+    vocab_size: int = 100_003  # per-column hash-bucket count (prime)
+    embed_dim: int = 16
+    hidden: Sequence[int] = (256, 128, 64)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [B, 39] float32; cols 0..12 numeric, 13..38 categorical ids."""
+        numeric = x[:, :NUM_NUMERIC].astype(self.compute_dtype)
+        cat = jnp.mod(x[:, NUM_NUMERIC:].astype(jnp.int32), self.vocab_size)
+        # Disjoint id space per column so one embedding table serves all 26
+        # (single large gather beats 26 small ones on TPU).
+        offsets = jnp.arange(NUM_CATEGORICAL, dtype=jnp.int32) * self.vocab_size
+        flat_ids = cat + offsets[None, :]
+
+        # Wide: linear-in-one-hot == per-id scalar weight, summed.
+        wide_table = self.param(
+            "wide_weights", nn.initializers.zeros, (NUM_CATEGORICAL * self.vocab_size, 1))
+        wide = jnp.sum(jnp.take(wide_table, flat_ids, axis=0)[..., 0], axis=1, keepdims=True)
+        wide = wide + nn.Dense(1, dtype=jnp.float32, name="wide_numeric")(
+            x[:, :NUM_NUMERIC])
+
+        # Deep: embeddings + MLP.
+        embed_table = self.param(
+            "embeddings", nn.initializers.normal(0.01),
+            (NUM_CATEGORICAL * self.vocab_size, self.embed_dim))
+        emb = jnp.take(embed_table, flat_ids, axis=0)  # [B, 26, D]
+        deep = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1).astype(self.compute_dtype), numeric], axis=-1)
+        for h in self.hidden:
+            deep = nn.relu(nn.Dense(h, dtype=self.compute_dtype)(deep))
+        deep = nn.Dense(1, dtype=jnp.float32, name="deep_head")(deep)
+        return (wide + deep)[:, 0]  # [B] logits
+
+
+@register("wide_deep")
+def build_wide_deep(config: dict) -> WideDeep:
+    return WideDeep(
+        vocab_size=config.get("vocab_size", 100_003),
+        embed_dim=config.get("embed_dim", 16),
+        hidden=tuple(config.get("hidden", (256, 128, 64))),
+        compute_dtype=jnp.bfloat16 if config.get("bf16", True) else jnp.float32,
+    )
+
+
+def init_params(model: WideDeep, rng: jax.Array):
+    return model.init(rng, jnp.zeros((1, NUM_NUMERIC + NUM_CATEGORICAL), jnp.float32))["params"]
+
+
+def make_loss_fn(model: WideDeep):
+    """Binary cross-entropy on {0,1} click labels."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["features"])
+        labels = batch["label"].astype(jnp.float32)
+        loss = jnp.mean(optax_sigmoid_bce(logits, labels))
+        preds = (logits > 0).astype(jnp.float32)
+        return loss, {"accuracy": jnp.mean((preds == labels).astype(jnp.float32))}
+
+    return loss_fn
+
+
+def optax_sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    import optax
+
+    return optax.sigmoid_binary_cross_entropy(logits, labels)
+
+
+def synthetic_criteo(n: int, seed: int = 0) -> list[dict]:
+    """Learnable synthetic CTR rows: label correlates with numeric col 0 and
+    categorical col 13 parity."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n):
+        numeric = rng.rand(NUM_NUMERIC).astype(np.float32)
+        cat = rng.randint(0, 1000, NUM_CATEGORICAL).astype(np.float32)
+        label = int((numeric[0] + (cat[0] % 2) * 0.5) > 0.75)
+        rows.append({"features": np.concatenate([numeric, cat]), "label": label})
+    return rows
+
+
+def batch_to_arrays(items: list) -> dict:
+    """(features, label) tuples or row dicts -> batch arrays."""
+    if isinstance(items[0], dict):
+        feats = np.stack([np.asarray(r["features"], np.float32) for r in items])
+        labels = np.asarray([r["label"] for r in items], np.int32)
+    else:
+        feats = np.stack([np.asarray(f, np.float32) for f, _ in items])
+        labels = np.asarray([l for _, l in items], np.int32)
+    return {"features": feats, "label": labels}

@@ -1,0 +1,372 @@
+"""ML pipeline layer — the ``TFEstimator``/``TFModel`` replacement.
+
+Reference (``tensorflowonspark/pipeline.py``, ~780 LoC): pyspark.ml
+``Estimator``/``Model`` subclasses with ~20 ``Has*`` Param mixins
+(``:~40-300``), ``Namespace``/``TFParams`` argv merging (``:~300-380``),
+``TFEstimator._fit`` = write TFRecords → ``TFCluster.run`` → ``train`` →
+``shutdown`` → ``TFModel`` (``:~400-500``), and ``TFModel._transform`` =
+per-executor cached SavedModel scoring with input/output column mappings
+(``:~500-700``).
+
+TPU-native redesign: no pyspark dependency — a small chainable Params system
+with the same ``setX``/``getX`` surface; datasets are ``PartitionedDataset``s
+of row-dicts; the model artifact is a bundle (params pytree + model-registry
+config, ``checkpoint.export_bundle``) instead of a SavedModel; transform
+batches rows through one jitted apply per process with the same cached-load
+behaviour the reference used for its per-executor SavedModel singleton.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from tensorflowonspark_tpu import cluster as _cluster
+from tensorflowonspark_tpu import dfutil
+from tensorflowonspark_tpu.checkpoint import load_bundle_cached
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.data import PartitionedDataset, as_partitioned
+
+logger = logging.getLogger(__name__)
+
+
+# -- params system (reference Has* mixins, pipeline.py:~40-300) ---------------
+
+class Param:
+    """One declared parameter (name, default, doc)."""
+
+    def __init__(self, name: str, default: Any = None, doc: str = ""):
+        self.name = name
+        self.default = default
+        self.doc = doc
+
+    def __repr__(self) -> str:
+        return f"Param({self.name!r}, default={self.default!r})"
+
+
+class Params:
+    """Declared-parameter container with chainable setters.
+
+    Mirrors the pyspark.ml Params surface the reference exposed
+    (``getBatchSize``/``setBatchSize`` …) without the pyspark dependency.
+    """
+
+    def __init__(self, **kwargs: Any):
+        self._values: dict[str, Any] = {}
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    @classmethod
+    def params(cls) -> dict[str, Param]:
+        out: dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for v in vars(klass).values():
+                if isinstance(v, Param):
+                    out[v.name] = v
+        return out
+
+    def set(self, name: str, value: Any) -> "Params":
+        if name not in self.params():
+            raise KeyError(f"unknown param {name!r}; declared: {sorted(self.params())}")
+        self._values[name] = value
+        return self
+
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        return self.params()[name].default
+
+    def is_set(self, name: str) -> bool:
+        return name in self._values
+
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self.params().items()):
+            mark = "(set)" if self.is_set(name) else "(default)"
+            lines.append(f"{name}: {p.doc} {mark} = {self.get(name)!r}")
+        return "\n".join(lines)
+
+    def copy(self) -> "Params":
+        c = copy.copy(self)
+        c._values = dict(self._values)
+        return c
+
+    def __getattr__(self, attr: str) -> Any:
+        # setBatchSize(v) / getBatchSize() accessor synthesis (camelCase →
+        # the snake_case param names used internally)
+        if attr.startswith(("set", "get")) and len(attr) > 3:
+            import re
+
+            name = re.sub(r"(?<!^)(?=[A-Z])", "_", attr[3:]).lower()
+            if name in self.params():
+                if attr.startswith("set"):
+                    return lambda value: self.set(name, value)
+                return lambda: self.get(name)
+        raise AttributeError(attr)
+
+    def to_namespace(self) -> "Namespace":
+        ns = {name: self.get(name) for name in self.params()}
+        return Namespace(ns)
+
+
+class HasBatchSize(Params):
+    batch_size = Param("batch_size", 64, "per-step global batch size")
+
+
+class HasEpochs(Params):
+    epochs = Param("epochs", 1, "number of passes over the training data")
+
+
+class HasSteps(Params):
+    steps = Param("steps", -1, "max training steps (-1 = until data exhausted)")
+
+
+class HasInputMapping(Params):
+    input_mapping = Param("input_mapping", None, "dict: row column -> model input")
+
+
+class HasOutputMapping(Params):
+    output_mapping = Param("output_mapping", None, "dict: model output -> result column")
+
+
+class HasInputMode(Params):
+    input_mode = Param("input_mode", InputMode.STREAMING, "DIRECT (files) or STREAMING (feed)")
+
+
+class HasMasterNode(Params):
+    master_node = Param("master_node", None, "name of the chief role")
+
+
+class HasNumExecutors(Params):
+    num_executors = Param("num_executors", 1, "number of node processes/hosts")
+
+
+class HasModelDir(Params):
+    model_dir = Param("model_dir", None, "checkpoint directory (hdfs:// ok)")
+
+
+class HasExportDir(Params):
+    export_dir = Param("export_dir", None, "bundle export directory (hdfs:// ok)")
+
+
+class HasTFRecordDir(Params):
+    tfrecord_dir = Param("tfrecord_dir", None,
+                         "stage the train dataset as TFRecords here before training")
+
+
+class HasTensorboard(Params):
+    tensorboard = Param("tensorboard", False, "spawn TensorBoard on the chief")
+
+
+class HasLogDir(Params):
+    log_dir = Param("log_dir", "", "node log/summary directory")
+
+
+class HasReaders(Params):
+    readers = Param("readers", 1, "per-node reader threads (DIRECT mode)")
+
+
+class HasFeedTimeout(Params):
+    feed_timeout = Param("feed_timeout", 600.0, "seconds before a stalled feed errors")
+
+
+class HasReservationTimeout(Params):
+    reservation_timeout = Param("reservation_timeout", 120.0,
+                                "seconds to wait for all nodes to register")
+
+
+class Namespace:
+    """Attribute-style argv bag (reference ``Namespace``, pipeline.py:~300-380).
+
+    Merges dicts / argparse namespaces / other Namespaces; later sources win.
+    """
+
+    def __init__(self, *sources: Any):
+        self.__dict__["_d"] = {}
+        for s in sources:
+            self.merge(s)
+
+    def merge(self, source: Any) -> "Namespace":
+        if source is None:
+            return self
+        if isinstance(source, Namespace):
+            self._d.update(source._d)
+        elif isinstance(source, dict):
+            self._d.update(source)
+        else:  # argparse.Namespace or any attr bag
+            self._d.update(vars(source))
+        return self
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_d"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self._d[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._d
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self._d.get(name, default)
+
+    def asdict(self) -> dict:
+        return dict(self._d)
+
+    def __repr__(self) -> str:
+        return f"Namespace({self._d!r})"
+
+
+class TPUParams(HasBatchSize, HasEpochs, HasSteps, HasInputMapping,
+                HasOutputMapping, HasInputMode, HasMasterNode, HasNumExecutors,
+                HasModelDir, HasExportDir, HasTFRecordDir, HasTensorboard,
+                HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout):
+    """All framework params in one mixin stack (reference ``TFParams``)."""
+
+    def merge_args_params(self, tf_args: Any = None) -> Namespace:
+        """Params-over-args merge the reference did before ``TFCluster.run``."""
+        ns = Namespace(tf_args)
+        for name in self.params():
+            if self.is_set(name) or name not in ns:
+                ns.merge({name: self.get(name)})
+        return ns
+
+
+# -- estimator (reference TFEstimator, pipeline.py:~400-500) ------------------
+
+class TPUEstimator(TPUParams):
+    """Train via a user ``train_fn(args, ctx)`` on a cluster; yields TPUModel.
+
+    ``train_fn`` must export a bundle to ``args.export_dir`` (the reference's
+    map_fun exported a SavedModel the same way).
+    """
+
+    def __init__(self, train_fn: Callable, tf_args: Any = None, **params: Any):
+        super().__init__(**params)
+        self.train_fn = train_fn
+        self.tf_args = tf_args
+
+    def fit(self, dataset: Any) -> "TPUModel":
+        args = self.merge_args_params(self.tf_args)
+        if args.get("export_dir") is None:
+            raise ValueError("TPUEstimator requires export_dir (the model artifact path)")
+        input_mode = args.input_mode
+        data = as_partitioned(dataset, default_partitions=max(1, args.num_executors))
+        if args.get("tfrecord_dir"):
+            # Stage to TFRecords so DIRECT-mode train_fns can read files
+            # (reference: dfutil.saveAsTFRecords before TFCluster.run).
+            rows = data if _is_row_data(data) else None
+            if rows is None:
+                raise ValueError("tfrecord_dir staging requires row-dict datasets")
+            dfutil.save_as_tfrecords(rows, args.tfrecord_dir)
+            args.merge({"data_dir": args.tfrecord_dir})
+        cluster = _cluster.run(
+            self.train_fn,
+            args,
+            num_executors=args.num_executors,
+            input_mode=input_mode,
+            master_node=args.master_node,
+            tensorboard=args.tensorboard,
+            log_dir=args.log_dir,
+            feed_timeout=args.feed_timeout,
+            reservation_timeout=args.reservation_timeout,
+        )
+        try:
+            if input_mode == InputMode.STREAMING:
+                cluster.train(data, num_epochs=args.epochs)
+        finally:
+            cluster.shutdown()
+        model = TPUModel(tf_args=args)
+        model.set("export_dir", args.export_dir)
+        for name in ("batch_size", "input_mapping", "output_mapping"):
+            if self.is_set(name):
+                model.set(name, self.get(name))
+        return model
+
+
+# -- model (reference TFModel, pipeline.py:~500-700) --------------------------
+
+class TPUModel(TPUParams):
+    """Batch inference over a partitioned dataset from an exported bundle."""
+
+    def __init__(self, tf_args: Any = None, **params: Any):
+        super().__init__(**params)
+        self.tf_args = tf_args
+
+    def transform(self, dataset: Any) -> PartitionedDataset:
+        """Score rows partition-by-partition; preserves partition order/count.
+
+        Rows are dicts; ``input_mapping`` {column → model input} selects
+        feature columns (default: the single column "features" or "image");
+        ``output_mapping`` {model output → column} names prediction columns
+        (default: {"prediction": "prediction"}).
+        """
+        args = self.merge_args_params(self.tf_args)
+        export_dir = args.get("export_dir")
+        if not export_dir:
+            raise ValueError("TPUModel requires export_dir")
+        data = as_partitioned(dataset, default_partitions=1)
+        batch_size = int(args.get("batch_size") or 64)
+        input_mapping = args.get("input_mapping")
+        output_mapping = args.get("output_mapping") or {"prediction": "prediction"}
+        parts = [
+            _score_partition(list(data.iter_partition(p)), export_dir,
+                             batch_size, input_mapping, output_mapping)
+            for p in range(data.num_partitions)
+        ]
+        return PartitionedDataset.from_partitions(parts)
+
+
+def _is_row_data(data: PartitionedDataset) -> bool:
+    for p in range(data.num_partitions):
+        for row in data.iter_partition(p):
+            return isinstance(row, dict)
+    return False
+
+
+def _score_partition(rows: list, export_dir: str, batch_size: int,
+                     input_mapping: dict | None, output_mapping: dict) -> list:
+    """Exactly-count, order-preserving scoring of one partition
+    (SURVEY.md §3.3 invariant).  Pads the tail batch for one static jit
+    shape, then unpads — no recompiles per partition tail."""
+    from tensorflowonspark_tpu.models.registry import build_apply
+
+    if not rows:
+        return []
+    params, config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    out: list = []
+    for start in range(0, len(rows), batch_size):
+        chunk = rows[start : start + batch_size]
+        n = len(chunk)
+        padded = chunk + [chunk[-1]] * (batch_size - n)
+        features = _rows_to_features(padded, input_mapping)
+        preds = apply_fn(params, features)
+        preds = np.asarray(preds)[:n]
+        for i in range(n):
+            row_out = dict(chunk[i]) if isinstance(chunk[i], dict) else {}
+            for _, col in output_mapping.items():
+                row_out[col] = preds[i]
+            out.append(row_out)
+    return out
+
+
+def _rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
+    """Stack the mapped feature column into one batch array."""
+    if isinstance(rows[0], dict):
+        if input_mapping:
+            col = next(iter(input_mapping))
+        elif "features" in rows[0]:
+            col = "features"
+        elif "image" in rows[0]:
+            col = "image"
+        else:
+            raise ValueError(
+                f"cannot pick a feature column from {sorted(rows[0])}; set input_mapping"
+            )
+        return np.stack([np.asarray(r[col], np.float32) for r in rows])
+    return np.stack([np.asarray(r, np.float32) for r in rows])

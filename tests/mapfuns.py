@@ -98,6 +98,45 @@ def custom_queue_consumer(args, ctx):
         f.write(str(seen))
 
 
+def train_wide_deep(args, ctx):
+    """Pipeline-style train_fn: stream rows, SPMD train, chief exports bundle.
+
+    ``args`` is a pipeline.Namespace carrying export_dir/batch_size/epochs
+    plus test knobs (vocab_size).
+    """
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import wide_deep
+    from tensorflowonspark_tpu.parallel import dp as dplib
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+    import jax
+
+    config = {"model": "wide_deep", "vocab_size": args.get("vocab_size", 1009),
+              "embed_dim": 4, "hidden": (16, 8), "bf16": False}
+    model = wide_deep.build_wide_deep(config)
+    params = wide_deep.init_params(model, jax.random.PRNGKey(0))
+    optimizer = optax.adam(1e-2)
+    mesh = meshlib.make_mesh(dp=-1)
+    state = dplib.TrainState.create(dplib.replicate(params, mesh), optimizer)
+    step_fn = dplib.make_train_step(wide_deep.make_loss_fn(model), optimizer)
+
+    feed = ctx.get_data_feed(train_mode=True)
+    batches = dplib.make_batch_iterator(
+        feed, int(args.get("batch_size", 16)), wide_deep.batch_to_arrays,
+        mesh=mesh, ctx=ctx)
+    loss = None
+    for batch, _n in batches:
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+    if ctx.executor_id == 0:
+        export_bundle(args.export_dir, jax.device_get(state.params), config)
+    ctx.barrier("export")  # everyone waits for the bundle before exiting
+    if loss is not None:
+        with open(os.path.join(args.log_dir, f"loss_{ctx.executor_id}.txt"), "w") as f:
+            f.write(str(loss))
+
+
 def hangs_forever(args, ctx):
     """Ignores EOF and stop signals (zombie teardown probe)."""
     while True:

@@ -1,0 +1,116 @@
+"""Pipeline layer tests (reference test_pipeline.py: TFEstimator.fit →
+TFModel.transform over a tiny dataset, params surface, namespace merging)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import pipeline
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.data import PartitionedDataset
+from tensorflowonspark_tpu.models import wide_deep
+
+import mapfuns
+
+
+class TestParams:
+    def test_accessor_synthesis(self):
+        p = pipeline.TPUParams()
+        p.setBatchSize(128).setEpochs(3)
+        assert p.getBatchSize() == 128
+        assert p.get("epochs") == 3
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(KeyError):
+            pipeline.TPUParams().set("nope", 1)
+        with pytest.raises(AttributeError):
+            pipeline.TPUParams().setNope(1)
+
+    def test_defaults_and_explain(self):
+        p = pipeline.TPUParams()
+        assert p.get("batch_size") == 64
+        assert not p.is_set("batch_size")
+        assert "batch_size" in p.explain_params()
+
+    def test_copy_isolated(self):
+        a = pipeline.TPUParams().setBatchSize(8)
+        b = a.copy().setBatchSize(16)
+        assert a.getBatchSize() == 8
+        assert b.getBatchSize() == 16
+
+
+class TestNamespace:
+    def test_merge_precedence(self):
+        ns = pipeline.Namespace({"a": 1, "b": 2}, {"b": 3})
+        assert ns.a == 1 and ns.b == 3
+        assert "a" in ns and "zz" not in ns
+
+    def test_argparse_source(self):
+        import argparse
+
+        src = argparse.Namespace(x=5)
+        assert pipeline.Namespace(src).x == 5
+
+    def test_params_merge_over_args(self):
+        est = pipeline.TPUParams().setBatchSize(32)
+        ns = est.merge_args_params({"batch_size": 8, "extra": "kept"})
+        assert ns.batch_size == 32      # set param wins
+        assert ns.extra == "kept"
+        ns2 = pipeline.TPUParams().merge_args_params({"batch_size": 8})
+        assert ns2.batch_size == 8      # unset param defers to args
+
+
+class TestFitTransform:
+    def test_fit_then_transform(self, tmp_path):
+        rows = wide_deep.synthetic_criteo(96, seed=1)
+        data = PartitionedDataset.from_iterable(rows, 4)
+        est = pipeline.TPUEstimator(
+            mapfuns.train_wide_deep,
+            {"vocab_size": 1009},
+        )
+        est.setNumExecutors(2).setEpochs(2).setBatchSize(16)
+        est.set("export_dir", str(tmp_path / "export"))
+        est.set("log_dir", str(tmp_path / "logs"))
+        model = est.fit(data)
+        assert os.path.isdir(tmp_path / "export")
+        # losses were written by both nodes
+        losses = [f for f in os.listdir(tmp_path / "logs") if f.startswith("loss_")]
+        assert len(losses) == 2
+
+        scored = model.transform(PartitionedDataset.from_iterable(rows[:20], 2))
+        out = list(scored)
+        assert len(out) == 20                      # exactly-count
+        assert scored.num_partitions == 2          # partition structure kept
+        assert all("prediction" in r for r in out)
+        # predictions align with input row order
+        assert all(np.allclose(r["features"], rows[i]["features"])
+                   for i, r in enumerate(out))
+
+    def test_estimator_requires_export_dir(self):
+        est = pipeline.TPUEstimator(mapfuns.noop, {})
+        with pytest.raises(ValueError, match="export_dir"):
+            est.fit([1, 2, 3])
+
+    def test_model_requires_export_dir(self):
+        with pytest.raises(ValueError, match="export_dir"):
+            pipeline.TPUModel().transform([{"features": np.zeros(39)}])
+
+    def test_transform_output_mapping(self, tmp_path):
+        from tensorflowonspark_tpu.checkpoint import export_bundle
+        import jax
+
+        config = {"model": "wide_deep", "vocab_size": 101, "embed_dim": 2,
+                  "hidden": (4,), "bf16": False}
+        model = wide_deep.build_wide_deep(config)
+        params = wide_deep.init_params(model, jax.random.PRNGKey(0))
+        export_bundle(str(tmp_path / "b"), jax.device_get(params), config)
+
+        m = pipeline.TPUModel()
+        m.set("export_dir", str(tmp_path / "b"))
+        m.set("output_mapping", {"logits": "score"})
+        m.setBatchSize(8)
+        rows = wide_deep.synthetic_criteo(5)
+        out = list(m.transform(PartitionedDataset.from_iterable(rows, 1)))
+        assert len(out) == 5
+        assert all("score" in r for r in out)
