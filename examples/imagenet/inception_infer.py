@@ -1,0 +1,77 @@
+"""Inception-v3 streaming inference — parity config 5
+(reference ``examples/imagenet/inception`` batch-inference via
+``TFCluster.inference`` RDD→GPU; BASELINE.json:11).
+
+Images stream from the driver through node feeds onto the TPU in static
+padded batches; results come back ordered, exactly one per image.
+
+Run:  python inception_infer.py --num-executors 1 --images 64 --image-size 299
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import tensorflowonspark_tpu as tos
+from tensorflowonspark_tpu.inference import bundle_inference_loop
+
+
+def export_random_bundle(export_dir: str, image_size: int) -> None:
+    """Export a randomly-initialized Inception-v3 bundle (stand-in for a
+    trained checkpoint; the reference example downloaded a pretrained one)."""
+    import jax
+
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import inception
+
+    config = {"model": "inception_v3", "num_classes": 1001, "bf16": True}
+    model = inception.build_inception_v3(config)
+    variables = inception.init_variables(model, jax.random.PRNGKey(0), image_size)
+    export_bundle(export_dir, jax.device_get(dict(variables)), config)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-executors", type=int, default=1)
+    p.add_argument("--images", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=299)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--partitions", type=int, default=4)
+    p.add_argument("--export-dir", default="")
+    p.add_argument("--log-dir", default=os.path.join(tempfile.gettempdir(), "inception_logs"))
+    args = p.parse_args()
+
+    export_dir = args.export_dir or os.path.join(tempfile.gettempdir(), "inception_bundle")
+    if not os.path.exists(os.path.join(export_dir, "bundle.json")):
+        print("exporting random-init bundle to", export_dir)
+        export_random_bundle(export_dir, args.image_size)
+
+    from tensorflowonspark_tpu.models import inception
+
+    images = inception.synthetic_images(args.images, args.image_size)
+    data = tos.PartitionedDataset.from_iterable(images, args.partitions)
+
+    cluster = tos.run(
+        bundle_inference_loop,
+        {"export_dir": export_dir, "batch_size": args.batch_size, "postprocess": "argmax"},
+        num_executors=args.num_executors,
+        input_mode=tos.InputMode.STREAMING,
+        log_dir=args.log_dir,
+    )
+    try:
+        preds = cluster.inference(data)
+    finally:
+        cluster.shutdown()
+    assert len(preds) == args.images, (len(preds), args.images)
+    print(f"scored {len(preds)} images; first 10 class ids: {preds[:10]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Streaming inference map_funs — the RDD→device→RDD scoring path.
+
+Reference (SURVEY.md §3.3): ``TFCluster.inference(dataRDD)`` streamed
+partitions through each node's queues into the user map_fun, which emitted
+exactly one result per input item via ``tf_feed.batch_results``.  The
+examples all hand-wrote that loop; here it ships as a framework map_fun
+driven by an exported bundle (config 5, Inception-v3 streaming inference,
+BASELINE.json:11).
+
+TPU notes: the feed batch is padded to a static shape before the jitted
+apply (one compile, no tail recompiles) and unpadded before emission so the
+exactly-count invariant holds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _arg(args, name, default=None):
+    if isinstance(args, dict):
+        return args.get(name, default)
+    return getattr(args, name, default)
+
+
+def bundle_inference_loop(args, ctx) -> None:
+    """map_fun: score the stream with the bundle at ``args.export_dir``.
+
+    Emits one prediction (np.ndarray of logits/scores) per input item, in
+    order.  Optional args: ``batch_size`` (default 64), ``postprocess``
+    ("argmax" to emit int class ids instead of logit vectors).
+    """
+    from tensorflowonspark_tpu.checkpoint import load_bundle_cached
+    from tensorflowonspark_tpu.models.registry import build_apply
+
+    export_dir = _arg(args, "export_dir")
+    if not export_dir:
+        raise ValueError("bundle_inference_loop requires args.export_dir")
+    batch_size = int(_arg(args, "batch_size", 64) or 64)
+    postprocess = _arg(args, "postprocess")
+
+    variables, config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        items = feed.next_batch(batch_size)
+        if not items:
+            continue
+        n = len(items)
+        padded = list(items) + [items[-1]] * (batch_size - n)
+        x = np.stack([np.asarray(i, np.float32) for i in padded])
+        preds = np.asarray(apply_fn(variables, x))[:n]
+        if postprocess == "argmax":
+            results = [int(p) for p in preds.argmax(axis=-1)]
+        else:
+            results = list(preds)
+        feed.batch_results(results)

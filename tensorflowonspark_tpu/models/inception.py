@@ -1,0 +1,181 @@
+"""Inception-v3 — parity config 5 (BASELINE.json:11: "Inception-v3 streaming
+inference via TFCluster.inference RDD→TPU"; reference
+``examples/imagenet/inception/``).
+
+Faithful Inception-v3 topology (stem → 3xA → B → 4xC → D → 2xE → pool →
+head, Szegedy et al. 2015) in Flax, TPU-first: bf16 activations/f32 BN,
+NHWC, every conv+BN+relu fused by XLA into MXU-friendly blocks.  The 299x299
+input of the reference is kept as the default but any size >= 75 works
+(fully-convolutional until the global pool).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu.models.registry import register
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.compute_dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        b1 = cb(64, (1, 1))(x, train)
+        b5 = cb(48, (1, 1))(x, train)
+        b5 = cb(64, (5, 5))(b5, train)
+        b3 = cb(64, (1, 1))(x, train)
+        b3 = cb(96, (3, 3))(b3, train)
+        b3 = cb(96, (3, 3))(b3, train)
+        bp = cb(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        b3 = cb(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        bd = cb(64, (1, 1))(x, train)
+        bd = cb(96, (3, 3))(bd, train)
+        bd = cb(96, (3, 3), strides=(2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        c7 = self.channels_7x7
+        b1 = cb(192, (1, 1))(x, train)
+        b7 = cb(c7, (1, 1))(x, train)
+        b7 = cb(c7, (1, 7))(b7, train)
+        b7 = cb(192, (7, 1))(b7, train)
+        bd = cb(c7, (1, 1))(x, train)
+        bd = cb(c7, (7, 1))(bd, train)
+        bd = cb(c7, (1, 7))(bd, train)
+        bd = cb(c7, (7, 1))(bd, train)
+        bd = cb(192, (1, 7))(bd, train)
+        bp = cb(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        b3 = cb(192, (1, 1))(x, train)
+        b3 = cb(320, (3, 3), strides=(2, 2), padding="VALID")(b3, train)
+        b7 = cb(192, (1, 1))(x, train)
+        b7 = cb(192, (1, 7))(b7, train)
+        b7 = cb(192, (7, 1))(b7, train)
+        b7 = cb(192, (3, 3), strides=(2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        b1 = cb(320, (1, 1))(x, train)
+        b3 = cb(384, (1, 1))(x, train)
+        b3 = jnp.concatenate(
+            [cb(384, (1, 3))(b3, train), cb(384, (3, 1))(b3, train)], axis=-1)
+        bd = cb(448, (1, 1))(x, train)
+        bd = cb(384, (3, 3))(bd, train)
+        bd = jnp.concatenate(
+            [cb(384, (1, 3))(bd, train), cb(384, (3, 1))(bd, train)], axis=-1)
+        bp = cb(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cb = partial(ConvBN, compute_dtype=self.compute_dtype)
+        x = x.astype(self.compute_dtype)
+        # stem
+        x = cb(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = cb(32, (3, 3), padding="VALID")(x, train)
+        x = cb(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cb(80, (1, 1), padding="VALID")(x, train)
+        x = cb(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # mixed 5b, 5c, 5d
+        x = InceptionA(32, self.compute_dtype)(x, train)
+        x = InceptionA(64, self.compute_dtype)(x, train)
+        x = InceptionA(64, self.compute_dtype)(x, train)
+        # mixed 6a
+        x = InceptionB(self.compute_dtype)(x, train)
+        # mixed 6b-6e
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, self.compute_dtype)(x, train)
+        # mixed 7a
+        x = InceptionD(self.compute_dtype)(x, train)
+        # mixed 7b, 7c
+        x = InceptionE(self.compute_dtype)(x, train)
+        x = InceptionE(self.compute_dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register("inception_v3")
+def build_inception_v3(config: dict) -> InceptionV3:
+    return InceptionV3(
+        num_classes=config.get("num_classes", 1000),
+        compute_dtype=jnp.bfloat16 if config.get("bf16", True) else jnp.float32,
+    )
+
+
+def init_variables(model: InceptionV3, rng: jax.Array, image_size: int = 299):
+    return model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+                      train=True)
+
+
+def synthetic_images(n: int, image_size: int = 299, seed: int = 0) -> list[np.ndarray]:
+    rng = np.random.RandomState(seed)
+    return [rng.rand(image_size, image_size, 3).astype(np.float32) for _ in range(n)]

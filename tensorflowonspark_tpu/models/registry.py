@@ -36,8 +36,24 @@ def build(config: dict):
 
 
 def build_apply(config: dict) -> Callable:
-    """Build a jitted ``apply(params, x)`` for a bundle config."""
+    """Build a jitted ``apply(variables, x)`` for a bundle config.
+
+    ``variables`` may be a bare params pytree or a full flax variables dict
+    (``{"params": ..., "batch_stats": ...}`` for BN models, which are applied
+    in inference mode).
+    """
+    import inspect
+
     import jax
 
     model = build(config)
-    return jax.jit(lambda params, x: model.apply({"params": params}, x))
+    takes_train = "train" in inspect.signature(model.__call__).parameters
+
+    def apply_fn(variables, x):
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        if takes_train:
+            return model.apply(variables, x, train=False)
+        return model.apply(variables, x)
+
+    return jax.jit(apply_fn)
