@@ -1,1 +1,9 @@
 """Custom ops: Pallas TPU kernels for the hot paths."""
+
+from tensorflowonspark_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention,
+    chunk_attention,
+    flash_attention,
+    merge_attention,
+    mha_reference,
+)
