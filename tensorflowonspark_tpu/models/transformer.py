@@ -1,0 +1,217 @@
+"""Decoder-only transformer LM — the long-context / parallelism flagship.
+
+The reference's model zoo stops at CNNs and wide-and-deep (its ``examples/``
+tree; SURVEY.md §5.7 records that sequence length is never a sharded axis
+there).  This family exists because long-context and model parallelism are
+first-class in the TPU build:
+
+- attention runs the Pallas flash kernel (``ops/attention.py``) on TPU, or
+  ring/Ulysses sequence parallelism (``parallel/sp.py``) when a mesh with an
+  ``sp`` axis is supplied;
+- param layouts follow ``parallel/tp.TRANSFORMER_TP_RULES`` (Megatron
+  column/row parallel over ``tp``, optionally composed with fsdp);
+- the FFN can be a dense SwiGLU or an expert-parallel MoE
+  (``parallel/ep.MoEMLP``) over ``ep``.
+
+Pre-norm RMSNorm + RoPE, bf16 compute / f32 params — the standard
+MXU-friendly recipe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.models.registry import register
+from tensorflowonspark_tpu.ops.attention import flash_attention
+from tensorflowonspark_tpu.parallel.tp import constrain
+
+BATCH = ("dp", "fsdp")
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary embedding, ``x: [B, S, H, D]``, ``positions: [S]``."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon)
+        return (norm * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    n_heads: int
+    d_head: int
+    rope_theta: float = 10000.0
+    attn_impl: str = "auto"       # auto | pallas | xla | reference | ring | ulysses
+    mesh: Optional[Any] = None    # required for ring/ulysses
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, _ = x.shape
+        h, dh = self.n_heads, self.d_head
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (h, dh), axis=-1, use_bias=False, name=name,
+            dtype=self.compute_dtype)
+        q, k, v = dense("q_proj")(x), dense("k_proj")(x), dense("v_proj")(x)
+        positions = jnp.arange(s)
+        q = apply_rope(q, positions, self.rope_theta)
+        k = apply_rope(k, positions, self.rope_theta)
+        q = constrain(q, P(BATCH, "sp", "tp", None))
+        k = constrain(k, P(BATCH, "sp", "tp", None))
+        v = constrain(v, P(BATCH, "sp", "tp", None))
+        if self.attn_impl in ("ring", "ulysses"):
+            if self.mesh is None:
+                raise ValueError("ring/ulysses attention needs mesh=")
+            from tensorflowonspark_tpu.parallel.sp import (
+                sequence_parallel_attention,
+            )
+            out = sequence_parallel_attention(self.mesh, q, k, v, causal=True,
+                                              impl=self.attn_impl)
+        else:
+            impl = None if self.attn_impl == "auto" else self.attn_impl
+            out = flash_attention(q, k, v, causal=True, impl=impl)
+        out = nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
+                              name="o_proj", dtype=self.compute_dtype)(out)
+        return out
+
+
+class SwiGLU(nn.Module):
+    d_ff: int
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=False, name=name, dtype=self.compute_dtype)
+        gate = jax.nn.silu(dense(self.d_ff, "gate_proj")(x))
+        up = dense(self.d_ff, "up_proj")(x)
+        h = constrain(gate * up, P(BATCH, "sp", "tp"))
+        return dense(x.shape[-1], "down_proj")(h)
+
+
+class Block(nn.Module):
+    n_heads: int
+    d_head: int
+    d_ff: int
+    n_experts: int = 0
+    moe_top_k: int = 2
+    rope_theta: float = 10000.0
+    attn_impl: str = "auto"
+    mesh: Optional[Any] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.n_heads, self.d_head, self.rope_theta,
+                          self.attn_impl, self.mesh, self.compute_dtype,
+                          name="attn")(RMSNorm(name="attn_norm")(x))
+        x = constrain(x, P(BATCH, "sp", None))
+        if self.n_experts:
+            from tensorflowonspark_tpu.parallel.ep import MoEMLP
+
+            ffn = MoEMLP(x.shape[-1], self.d_ff, self.n_experts,
+                         self.moe_top_k, compute_dtype=self.compute_dtype,
+                         name="moe")
+        else:
+            ffn = SwiGLU(self.d_ff, self.compute_dtype, name="mlp")
+        x = x + ffn(RMSNorm(name="mlp_norm")(x))
+        return constrain(x, P(BATCH, "sp", None))
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM.  ``__call__(input_ids: [B, S]) -> logits [B, S, V]``."""
+
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_head: int = 0          # 0 ⇒ d_model // n_heads
+    d_ff: int = 0            # 0 ⇒ 4 * d_model
+    n_experts: int = 0       # 0 ⇒ dense FFN
+    moe_top_k: int = 2
+    rope_theta: float = 10000.0
+    attn_impl: str = "auto"
+    mesh: Optional[Any] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids):
+        dh = self.d_head or self.d_model // self.n_heads
+        dff = self.d_ff or 4 * self.d_model
+        emb = nn.Embed(self.vocab_size, self.d_model, name="embed",
+                       dtype=self.compute_dtype)
+        x = emb(input_ids)
+        x = constrain(x, P(BATCH, "sp", None))
+        for i in range(self.n_layers):
+            x = Block(self.n_heads, dh, dff, self.n_experts, self.moe_top_k,
+                      self.rope_theta, self.attn_impl, self.mesh,
+                      self.compute_dtype, name=f"block_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, name="lm_head",
+                          dtype=self.compute_dtype)(x)
+        return constrain(logits.astype(jnp.float32), P(BATCH, "sp", None))
+
+
+@register("transformer")
+def build_transformer(config: dict) -> Transformer:
+    return Transformer(
+        vocab_size=int(config.get("vocab_size", 32000)),
+        d_model=int(config.get("d_model", 512)),
+        n_layers=int(config.get("n_layers", 4)),
+        n_heads=int(config.get("n_heads", 8)),
+        d_head=int(config.get("d_head", 0)),
+        d_ff=int(config.get("d_ff", 0)),
+        n_experts=int(config.get("n_experts", 0)),
+        moe_top_k=int(config.get("moe_top_k", 2)),
+        rope_theta=float(config.get("rope_theta", 10000.0)),
+        attn_impl=config.get("attn_impl", "auto"),
+        compute_dtype=jnp.bfloat16 if config.get("bf16", True) else jnp.float32,
+    )
+
+
+def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01):
+    """Next-token LM loss.  Batch: ``{"input_ids": [B, S] int32}`` (targets
+    are inputs shifted left; final position predicts a discarded token).
+    MoE load-balance aux losses are collected from the ``aux_loss`` sow."""
+
+    def loss_fn(params, batch):
+        ids = batch["input_ids"]
+        logits, updates = model.apply({"params": params}, ids,
+                                      mutable=["aux_loss"])
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        targets = ids[:, 1:]
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            loss = jnp.mean(nll)
+        aux = sum(jax.tree.leaves(updates.get("aux_loss", {})), 0.0)
+        total = loss + aux_loss_coef * aux
+        return total, {"lm_loss": loss, "aux_loss": jnp.asarray(aux)}
+
+    return loss_fn

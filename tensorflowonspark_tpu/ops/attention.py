@@ -32,6 +32,16 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() exactly 0 without nan
 
 
+def match_vma(x, like):
+    """Mark a freshly-created array as device-varying over the same shard_map
+    axes as ``like`` (no-op outside shard_map).  Scan carries must type-match
+    their per-step outputs under jax's varying-manual-axes tracking."""
+    vma = getattr(jax.typeof(like), "vma", frozenset())
+    if vma:
+        return jax.lax.pcast(x, axis_name=tuple(vma), to="varying")
+    return x
+
+
 # ---------------------------------------------------------------------------
 # Reference (dense) attention — the spec the kernels are tested against.
 # ---------------------------------------------------------------------------
@@ -147,9 +157,9 @@ def blockwise_attention(q, k, v, *, causal: bool = True,
                  + jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)))
         return (o_new, m_new, l_new), None
 
-    o0 = jnp.zeros((b, sq, h, d), jnp.float32)
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = match_vma(jnp.zeros((b, sq, h, d), jnp.float32), q)
+    m0 = match_vma(jnp.full((b, h, sq), NEG_INF, jnp.float32), q)
+    l0 = match_vma(jnp.zeros((b, h, sq), jnp.float32), q)
     starts = jnp.arange(nblocks) * block_k
     (o, m, l), _ = jax.lax.scan(block, (o0, m0, l0), (kb, vb, starts))
     o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]

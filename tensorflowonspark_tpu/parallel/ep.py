@@ -1,0 +1,106 @@
+"""Expert parallelism: mixture-of-experts FFN sharded over the ``ep`` axis.
+
+Absent in the reference (SURVEY.md §2.3).  TPU-idiomatic MoE is the
+GShard/Switch einsum formulation: top-k routing with a *static* per-expert
+capacity, dispatch/combine as one-hot einsums (MXU-friendly, no dynamic
+shapes), expert-stacked weights with the expert dimension sharded over
+``ep`` — GSPMD turns the dispatch einsums into all-to-alls over ICI.
+Overflow tokens beyond capacity are dropped (their combine weight is zero),
+the standard capacity-factor trade-off.
+
+``MoEMLP`` is a flax module usable standalone or inside
+``models/transformer.py``; the load-balancing auxiliary loss is sown into
+the ``"aux_loss"`` collection (fetch with ``mutable=["aux_loss"]``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.parallel.tp import constrain
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU MoE FFN, ``[B, S, D] -> [B, S, D]``.
+
+    Param layout (matched by ``tp.TRANSFORMER_TP_RULES``): ``router/kernel``
+    replicated; ``experts_gate``/``experts_up`` ``[E, D, F]`` and
+    ``experts_down`` ``[E, F, D]`` sharded ``P('ep', …)`` (+ tp on F).
+    """
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        n = b * s
+        e = self.n_experts
+        xf = x.reshape(n, d)
+
+        router = nn.Dense(e, use_bias=False, name="router",
+                          dtype=jnp.float32)  # routing always f32
+        probs = jax.nn.softmax(router(xf.astype(jnp.float32)), axis=-1)
+        top_p, top_idx = jax.lax.top_k(probs, self.top_k)         # [n, k]
+        top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+
+        capacity = max(1, int(math.ceil(n * self.capacity_factor
+                                        * self.top_k / e)))
+
+        # GShard dispatch: slots are filled in top-k priority order; a
+        # token's j-th choice only lands if the expert still has room after
+        # all higher-priority assignments.
+        counts = jnp.zeros((e,), jnp.float32)
+        dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+        combine = jnp.zeros((n, e, capacity), jnp.float32)
+        for j in range(self.top_k):
+            oh = _one_hot(top_idx[:, j], e)                       # [n, e]
+            pos = jnp.cumsum(oh, axis=0) - oh + counts[None, :]   # [n, e]
+            keep = (pos < capacity).astype(jnp.float32) * oh
+            counts = counts + jnp.sum(keep, axis=0)
+            slot = _one_hot(jnp.sum(pos * oh, axis=-1).astype(jnp.int32),
+                            capacity)                             # [n, c]
+            d_j = keep[:, :, None] * slot[:, None, :]
+            dispatch = dispatch + d_j
+            combine = combine + d_j * top_p[:, j][:, None, None]
+
+        # Load-balancing aux loss (Switch eq. 4): e · Σ_e f_e · P_e .
+        frac_tokens = jnp.mean(_one_hot(top_idx[:, 0], e), axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        self.sow("aux_loss", "load_balance",
+                 e * jnp.sum(frac_tokens * frac_probs))
+
+        w_gate = self.param("experts_gate", nn.initializers.lecun_normal(),
+                            (e, d, self.d_ff))
+        w_up = self.param("experts_up", nn.initializers.lecun_normal(),
+                          (e, d, self.d_ff))
+        w_down = self.param("experts_down", nn.initializers.lecun_normal(),
+                            (e, self.d_ff, d))
+
+        cdt = self.compute_dtype
+        # The ep constraints make GSPMD materialise the token shuffle as
+        # all-to-alls over the ep axis (tokens in, expert outputs back).
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt),
+                               xf.astype(cdt))
+        expert_in = constrain(expert_in, P("ep", None, None))
+        h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                    w_gate.astype(cdt)))
+             * jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cdt)))
+        h = constrain(h, P("ep", None, "tp"))
+        out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cdt))
+        out = constrain(out, P("ep", None, None))
+        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+        return y.reshape(b, s, d).astype(x.dtype)

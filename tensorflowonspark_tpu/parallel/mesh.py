@@ -110,15 +110,27 @@ def fsdp_shardings(mesh: Mesh, tree):
     def leaf_sharding(x) -> NamedSharding:
         if axis_size == 1 or not hasattr(x, "shape") or x.ndim == 0:
             return replicated(mesh)
-        dims = sorted(range(x.ndim), key=lambda d: x.shape[d], reverse=True)
-        for d in dims:
-            if x.shape[d] % axis_size == 0 and x.shape[d] >= axis_size:
-                pspec = [None] * x.ndim
-                pspec[d] = "fsdp"
-                return NamedSharding(mesh, P(*pspec))
-        return replicated(mesh)
+        d = pick_shard_dim(x.shape, axis_size)
+        if d is None:
+            return replicated(mesh)
+        pspec = [None] * x.ndim
+        pspec[d] = "fsdp"
+        return NamedSharding(mesh, P(*pspec))
 
     return jax.tree.map(leaf_sharding, tree)
+
+
+def pick_shard_dim(shape, axis_size: int, taken=()) -> int | None:
+    """Largest dim divisible by ``axis_size`` (skipping ``taken`` dims), or
+    None if nothing splits evenly — the shared heuristic behind fsdp
+    sharding here and ``tp.compose_fsdp``."""
+    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+    for d in dims:
+        if d in taken:
+            continue
+        if shape[d] % axis_size == 0 and shape[d] >= axis_size:
+            return d
+    return None
 
 
 def shard_tree(mesh: Mesh, tree, shardings=None):

@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+
+Absent in the reference (SURVEY.md §2.3).  TPU-idiomatic form: every device
+holds one stage's params (stage-stacked pytree sharded ``P('pp', …)``), the
+schedule is a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks inside
+``shard_map``, and activations hop stage→stage with ``jax.lax.ppermute``
+over ICI neighbours.  All stages run the same ``stage_fn`` SPMD program each
+tick (on their own microbatch-in-flight), so utilisation follows the classic
+GPipe bubble 1 - m/(m+s-1).
+
+Differentiable end-to-end: grads flow back through the scan + ppermute, so
+``jax.grad`` over a pipelined loss just works (the backward pipeline is the
+reverse-time scan XLA derives).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.ops.attention import match_vma
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
+          x: jax.Array, *, mesh, n_microbatches: int, axis_name: str = "pp"):
+    """Run ``x`` through a pipeline of stages; returns the final activations.
+
+    - ``stage_params``: pytree whose leaves have a leading ``n_stages`` dim
+      (stage-stacked); sharded over ``pp`` by the wrapper.
+    - ``stage_fn(params_i, mb) -> mb``: one stage's computation; activation
+      shapes must be identical between stages (the inter-stage wire format).
+    - ``x``: global batch ``[B, …]`` with ``B % n_microbatches == 0``.
+    """
+    n_stages = mesh.shape[axis_name]
+    if x.shape[0] % n_microbatches:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_microbatches {n_microbatches}")
+
+    def body(params, xb):
+        params = jax.tree.map(lambda a: a[0], params)   # local stage's slice
+        idx = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        mb = xb.shape[0] // n_microbatches
+        xs = xb.reshape((n_microbatches, mb) + xb.shape[1:])
+        ticks = n_microbatches + n - 1
+        pad = jnp.zeros((n - 1, mb) + xb.shape[1:], xb.dtype)
+        feed = jnp.concatenate([xs, pad], axis=0)        # [ticks, mb, ...]
+
+        fwd = [(i, i + 1) for i in range(n - 1)]         # non-cyclic shift
+
+        def tick(carry, inp):
+            recv, outputs, t = carry
+            cur = jnp.where(idx == 0, inp, recv)
+            out = stage_fn(params, cur)
+            nxt = jax.lax.ppermute(out, axis_name, fwd)
+            # Last stage finishes microbatch t-(n-1) at tick t.
+            slot = jnp.clip(t - (n - 1), 0, n_microbatches - 1)
+            contrib = jnp.where((idx == n - 1) & (t >= n - 1), out, 0.0)
+            outputs = jax.lax.dynamic_update_slice(
+                outputs, (jax.lax.dynamic_slice_in_dim(outputs, slot, 1)
+                          + contrib[None]),
+                (slot,) + (0,) * out.ndim)
+            return (nxt, outputs, t + 1), None
+
+        out0 = match_vma(jnp.zeros((n_microbatches, mb) + xb.shape[1:],
+                                   jnp.result_type(xb.dtype, jnp.float32)), xb)
+        recv0 = match_vma(jnp.zeros((mb,) + xb.shape[1:], xb.dtype), xb)
+        (_, outputs, _), _ = jax.lax.scan(
+            tick, (recv0, out0, jnp.int32(0)), feed)
+        # Only the last stage holds real outputs; psum broadcasts them (all
+        # other stages contribute zeros).
+        outputs = jax.lax.psum(outputs, axis_name)
+        return outputs.reshape((xb.shape[0],) + xb.shape[1:]).astype(xb.dtype)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return mapped(stage_params, x)
+
+
+def stack_stages(param_trees: list) -> Any:
+    """Stack per-stage param pytrees into the stage-stacked layout."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def stage_shardings(mesh, stacked_params, axis_name: str = "pp"):
+    """NamedShardings placing the leading stage dim over ``pp``."""
+    from jax.sharding import NamedSharding
+
+    def leaf(x):
+        return NamedSharding(mesh, P(*((axis_name,) + (None,) * (x.ndim - 1))))
+
+    return jax.tree.map(leaf, stacked_params)

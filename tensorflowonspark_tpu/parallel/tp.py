@@ -1,0 +1,111 @@
+"""Tensor (model) parallelism: GSPMD sharding rules over the ``tp`` axis.
+
+The reference has no model parallelism at all (SURVEY.md §2.3 — "leave a
+model axis as an extension point"); here it is first-class.  TPU-idiomatic
+TP is *not* explicit collectives: params get Megatron-style layouts
+(column-parallel up-projections, row-parallel down-projections) as
+``PartitionSpec`` annotations, activations get ``with_sharding_constraint``
+hints, and XLA/GSPMD inserts the all-reduces over ICI.
+
+Rules are ``(path_regex, PartitionSpec)`` pairs matched against the
+``/``-joined param path; first match wins, no match ⇒ replicated-over-tp
+(then fsdp sharding may still apply via ``compose_fsdp``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def rule_shardings(mesh: Mesh, tree, rules: Rules, *, default: P = P()):
+    """Per-leaf NamedShardings from path-regex rules (first match wins)."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def leaf(path, x):
+        s = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(s):
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, default)
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+def compose_fsdp(mesh: Mesh, tree, shardings):
+    """Layer fsdp sharding on top of tp rules: any leaf dim not already
+    tp-sharded is split over ``fsdp`` (largest divisible dim), so TP and
+    ZeRO-3 compose the way Megatron-LM + FSDP do."""
+    from tensorflowonspark_tpu.parallel.mesh import pick_shard_dim
+
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("fsdp", 1)
+
+    def leaf(x, sharding):
+        if axis_size == 1 or not hasattr(x, "shape") or x.ndim == 0:
+            return sharding
+        spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
+        used = {a for s in spec if s is not None
+                for a in (s if isinstance(s, tuple) else (s,))}
+        if "fsdp" in used:
+            return sharding
+        taken = tuple(d for d, s in enumerate(spec) if s is not None)
+        d = pick_shard_dim(x.shape, axis_size, taken)
+        if d is None:
+            return sharding
+        spec[d] = "fsdp"
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(leaf, tree, shardings)
+
+
+def constrain(x, spec: P):
+    """Activation sharding hint; no-op when no mesh context is active (so
+    models run unchanged on a bare single device / in unit tests)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        return x
+    known = set(mesh.axis_names)
+    clean = P(*(
+        (tuple(a for a in s if a in known) or None)
+        if isinstance(s, tuple) else (s if s in known else None)
+        for s in spec
+    ))
+    return jax.lax.with_sharding_constraint(x, clean)
+
+
+# Megatron-style rule set for the transformer family (models/transformer.py
+# param tree): attention q/k/v shard the heads dim (column-parallel), o_proj
+# the heads-input dim (row-parallel); MLP up/gate column-, down row-parallel;
+# embeddings/lm_head shard the vocab; norms replicate.
+# q/k/v kernels are DenseGeneral 3-D [d_model, heads, d_head]; o_proj is
+# [heads, d_head, d_model].
+TRANSFORMER_TP_RULES: Rules = (
+    (r"(q_proj|k_proj|v_proj)/kernel$", P(None, "tp", None)),
+    (r"o_proj/kernel$", P("tp", None, None)),
+    (r"(up_proj|gate_proj)/kernel$", P(None, "tp")),
+    (r"down_proj/kernel$", P("tp", None)),
+    (r"embed/embedding$", P("tp", None)),
+    (r"lm_head/kernel$", P(None, "tp")),
+    # MoE expert-stacked weights: leading dim is the expert axis (ep), the
+    # per-expert matrices keep Megatron layouts over tp.
+    (r"experts_(up|gate)$", P("ep", None, "tp")),
+    (r"experts_down$", P("ep", "tp", None)),
+    (r"router/kernel$", P()),
+)
