@@ -1,0 +1,125 @@
+"""Long-context LM training — the parallelism-suite showcase.
+
+No reference counterpart (the reference's model zoo stops at CNNs /
+wide-and-deep; SURVEY.md §5.7): this example exists because long-context and
+model parallelism are first-class in the TPU build.  A decoder-only
+transformer trains over a mesh combining data (dp), tensor (tp, Megatron
+layouts) and sequence (sp, ring attention over ICI neighbours) parallelism;
+on TPU the attention runs the Pallas flash kernel when sp=1.
+
+Runs standalone on whatever devices are visible:
+
+  # 8 virtual CPU devices, ring attention over sp=2:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python train_lm.py --tp 2 --sp 2 --seq-len 512 --steps 10
+
+  # single real TPU chip, Pallas flash attention:
+  python train_lm.py --seq-len 2048 --steps 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def synthetic_ids(batch, seq_len, vocab, seed=0):
+    """Zipf-ish token stream: enough structure for the loss to move."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    base = rng.zipf(1.5, size=(batch, seq_len)).astype("int64")
+    return (base % vocab).astype("int32")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab-size", type=int, default=4096)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-experts", type=int, default=0, help=">0 enables MoE over ep")
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax profiler trace of the steady state here")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import profiling
+    from tensorflowonspark_tpu.models import transformer as tfm
+    from tensorflowonspark_tpu.parallel import dp as dplib
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+    from tensorflowonspark_tpu.parallel import tp as tplib
+
+    mesh = meshlib.make_mesh(dp=-1, tp=args.tp, sp=args.sp, ep=args.ep)
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"on {jax.default_backend()}")
+
+    attn_impl = "ring" if args.sp > 1 else "auto"
+    model = tfm.Transformer(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads,
+        n_experts=args.n_experts, attn_impl=attn_impl, mesh=mesh,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+
+    ids = jnp.asarray(synthetic_ids(args.batch, args.seq_len, args.vocab_size))
+    # init traces the model too, so the init batch must satisfy the same
+    # mesh divisibility as training batches (the ring-attention shard_map).
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(f"model: {n_params/1e6:.2f}M params, attn={attn_impl}")
+
+    optimizer = optax.adamw(args.lr)
+    with jax.set_mesh(mesh):
+        shardings = tplib.rule_shardings(mesh, params,
+                                         tplib.TRANSFORMER_TP_RULES)
+        shardings = tplib.compose_fsdp(mesh, params, shardings)
+        params = meshlib.shard_tree(mesh, params, shardings)
+        state = dplib.TrainState.create(params, optimizer)
+        step = dplib.make_train_step(tfm.make_loss_fn(model), optimizer)
+        batch = meshlib.shard_batch(mesh, {"input_ids": np.asarray(ids)})
+
+        state, metrics = step(state, batch)  # compile
+        print(f"step 0: loss={float(metrics['loss']):.4f}")
+
+        def one_step():
+            nonlocal state
+            state, m = step(state, batch)
+            return m
+
+        t0 = time.perf_counter()
+        if args.profile_dir:
+            # warmup already happened (the compile step above), so the timed
+            # window covers exactly args.steps executions.
+            metrics = profiling.profile_steps(args.profile_dir, one_step,
+                                              warmup=0, steps=args.steps)
+        else:
+            for _ in range(args.steps):
+                metrics = one_step()
+        loss = float(metrics["loss"])  # fetch = sync
+        dt = time.perf_counter() - t0
+
+        tokens = args.batch * args.seq_len * args.steps
+        print(f"step {args.steps}: loss={loss:.4f} "
+              f"({tokens / dt:,.0f} tokens/sec)")
+
+
+if __name__ == "__main__":
+    main()

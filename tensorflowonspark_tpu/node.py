@@ -198,7 +198,16 @@ def node_main(config: NodeConfig) -> int:
     server = DataServer(queues, config.authkey, config.feed_timeout)
     data_port = server.start()
 
-    ident = client.register({"host": local_ip(), "data_port": data_port, "pid": os.getpid()})
+    from tensorflowonspark_tpu import tpu_info
+
+    # jax.distributed.initialize must run before anything initialises the XLA
+    # backend, and device_summary() does (jax.devices()).  In distributed
+    # mode register a placeholder and fill in real hardware via update_meta
+    # right after initialize.
+    device_meta = ({"platform": "pending_distributed_init"}
+                   if config.jax_distributed else tpu_info.device_summary())
+    ident = client.register({"host": local_ip(), "data_port": data_port,
+                             "pid": os.getpid(), "device": device_meta})
     executor_id = ident["executor_id"]
     cluster_info = client.await_cluster(timeout=config.reservation_timeout)
 
@@ -228,6 +237,7 @@ def node_main(config: NodeConfig) -> int:
             num_processes=len(cluster_info),
             process_id=executor_id,
         )
+        client.update_meta(executor_id, {"device": tpu_info.device_summary()})
 
     ctx = NodeContext(
         executor_id=executor_id,

@@ -38,6 +38,12 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
     if x.shape[0] % n_microbatches:
         raise ValueError(f"batch {x.shape[0]} not divisible by "
                          f"n_microbatches {n_microbatches}")
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
+                f"dim {leaf.shape[0]}, expected n_stages={n_stages} "
+                f"(use stack_stages)")
 
     def body(params, xb):
         params = jax.tree.map(lambda a: a[0], params)   # local stage's slice

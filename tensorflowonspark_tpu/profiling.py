@@ -1,0 +1,68 @@
+"""Profiling/tracing — JAX profiler traces viewable in TensorBoard.
+
+Reference (SURVEY.md §5.1): no in-repo profiler; observability is the
+TensorBoard subprocess TFoS spawns and whatever users write in ``map_fun``.
+TPU build keeps that surface and backs it with the JAX profiler: traces
+written under ``<log_dir>/plugins/profile`` appear in TensorBoard's profile
+plugin next to the scalars ``summary.py`` writes.
+
+Surfaces:
+- ``trace(log_dir)`` — context manager around a region (e.g. N train steps);
+- ``profile_steps(log_dir, step_iter, warmup, steps)`` — trace a step-loop
+  window, the standard "skip compile, profile steady state" recipe;
+- ``annotate(name)`` — named sub-region (shows as a track in the viewer);
+- ``server(port)`` — on-demand profiling server for ``tensorboard capture``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Trace everything inside the block into ``log_dir`` (TensorBoard
+    profile plugin format).  Safe on CPU-only test hosts."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region: ``with annotate('train_step'): ...``."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def profile_steps(log_dir: str, step_fn, *, warmup: int = 2, steps: int = 5):
+    """Run ``step_fn()`` ``warmup`` times untraced (compile + cache), then
+    ``steps`` times inside a trace.  Returns the last step's result."""
+    result = None
+    for _ in range(warmup):
+        result = step_fn()
+    with trace(log_dir):
+        for i in range(steps):
+            with annotate(f"step_{i}"):
+                result = step_fn()
+    return result
+
+
+def server(port: int = 9012):
+    """Start the on-demand profiler server (``tensorboard capture`` target).
+
+    Returns the server object (keep a reference; there is no stop API in
+    jax's public surface — it lives for the process)."""
+    import jax
+
+    logger.info("starting jax profiler server on port %d", port)
+    return jax.profiler.start_server(port)

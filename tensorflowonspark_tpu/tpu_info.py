@@ -1,0 +1,130 @@
+"""TPU chip discovery and topology assignment — the ``gpu_info`` replacement.
+
+Reference (``tensorflowonspark/gpu_info.py``): parse ``nvidia-smi``, pick
+free GPUs with randomized retries to dodge allocation races between
+executors sharing a host, export ``CUDA_VISIBLE_DEVICES``.
+
+TPU-native redesign (SURVEY.md §2.2 row "Hops-YARN GPU scheduling", §5.2):
+TPU chips are per-host hardware, not a shared pool to race over, and the
+platform already knows its own topology.  So this module:
+
+- **discovers** what this process can see (``device_summary`` — platform,
+  chip kind, count, per-chip mesh coordinates from PJRT) for the node's
+  coordinator registration payload;
+- **assigns** race-free: ``plan_topology`` computes each host's process
+  index and chip-coordinate block centrally (the coordinator calls it once,
+  replacing gpu_info's randomized retries with deterministic assignment);
+- **scopes visibility** for subprocesses: ``chip_visibility_env`` returns
+  the env (``TPU_VISIBLE_CHIPS``/``TPU_PROCESS_BOUNDS``-style, or
+  ``JAX_PLATFORMS``/``XLA_FLAGS`` for CPU simulation) that makes a child
+  process see only its slice — the ``CUDA_VISIBLE_DEVICES`` analogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+
+def is_tpu_available() -> bool:
+    """Reference parity: ``gpu_info.is_gpu_available()``."""
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def device_summary() -> dict:
+    """What this process sees; goes into the coordinator registration payload
+    so the driver's ``cluster_info`` reports real hardware per node."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": jax.default_backend(),
+            "device_kind": devices[0].device_kind if devices else "none",
+            "num_devices": len(devices),
+            "coords": [list(getattr(d, "coords", ()) or ()) for d in devices],
+            "process_index": getattr(devices[0], "process_index", 0) if devices else 0,
+        }
+    except Exception:
+        return {"platform": "none", "device_kind": "none", "num_devices": 0,
+                "coords": [], "process_index": 0}
+
+
+@dataclasses.dataclass(frozen=True)
+class HostAssignment:
+    """One host's slot in the pod: its process id and global chip slice."""
+
+    executor_id: int
+    process_id: int
+    chip_start: int      # first global chip index owned by this host
+    num_chips: int
+
+    @property
+    def chip_ids(self) -> tuple[int, ...]:
+        return tuple(range(self.chip_start, self.chip_start + self.num_chips))
+
+
+def plan_topology(chip_counts: Sequence[int]) -> list[HostAssignment]:
+    """Deterministic global chip numbering from per-host chip counts.
+
+    Called centrally (driver/coordinator) with each registered node's
+    ``device_summary()["num_devices"]``, in executor-id order.  No retries,
+    no races — the reference's gpu_info randomized-pick loop is replaced by
+    one authoritative assignment (SURVEY.md §5.2 disposition).
+    """
+    out = []
+    start = 0
+    for i, n in enumerate(chip_counts):
+        out.append(HostAssignment(executor_id=i, process_id=i,
+                                  chip_start=start, num_chips=int(n)))
+        start += int(n)
+    return out
+
+
+def total_chips(assignments: Sequence[HostAssignment]) -> int:
+    return sum(a.num_chips for a in assignments)
+
+
+def default_mesh_axes(n_chips: int, *, model_parallel: int = 1) -> dict:
+    """Recommended mesh axis sizes for a chip count: everything on ``dp``
+    except an optional ``tp`` factor (must divide the chip count)."""
+    if n_chips % model_parallel:
+        raise ValueError(f"model_parallel {model_parallel} does not divide "
+                         f"chip count {n_chips}")
+    return {"dp": n_chips // model_parallel, "tp": model_parallel}
+
+
+def chip_visibility_env(chip_ids: Sequence[int], *, platform: str = "tpu",
+                        simulate_chips: int | None = None) -> dict[str, str]:
+    """Env for a child process that must see only ``chip_ids``.
+
+    On TPU hosts this is the ``CUDA_VISIBLE_DEVICES`` analogue
+    (``TPU_VISIBLE_CHIPS`` plus single-process bounds, the libtpu
+    convention for carving a host's chips between processes).  With
+    ``platform='cpu'`` it returns the virtual-device simulation env used by
+    tests and the multi-process local launcher.
+    """
+    if platform == "cpu":
+        n = simulate_chips if simulate_chips is not None else len(chip_ids)
+        return {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={max(1, n)}",
+        }
+    ids = ",".join(str(int(c)) for c in chip_ids)
+    n = len(chip_ids)
+    side = max(1, int(math.isqrt(n)))
+    if side * side != n:
+        side = 1  # non-square slice: 1 x n bounds
+    bounds = f"{side},{n // side},1"
+    return {
+        "TPU_VISIBLE_CHIPS": ids,
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": bounds,
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+    }

@@ -1,0 +1,65 @@
+"""tpu_info (gpu_info replacement) and profiling subsystem."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import profiling, tpu_info
+
+
+def test_device_summary_reports_cpu_platform():
+    s = tpu_info.device_summary()
+    assert s["platform"] == "cpu"
+    assert s["num_devices"] == 8  # conftest virtual devices
+    assert len(s["coords"]) == 8
+
+
+def test_is_tpu_available_false_on_cpu():
+    assert tpu_info.is_tpu_available() is False
+
+
+def test_plan_topology_contiguous_no_overlap():
+    plan = tpu_info.plan_topology([4, 4, 8])
+    assert [a.chip_start for a in plan] == [0, 4, 8]
+    assert tpu_info.total_chips(plan) == 16
+    seen = set()
+    for a in plan:
+        assert not (seen & set(a.chip_ids))
+        seen |= set(a.chip_ids)
+    assert seen == set(range(16))
+
+
+def test_default_mesh_axes():
+    assert tpu_info.default_mesh_axes(16) == {"dp": 16, "tp": 1}
+    assert tpu_info.default_mesh_axes(16, model_parallel=4) == {"dp": 4, "tp": 4}
+
+
+def test_chip_visibility_env_tpu_square_and_linear():
+    env = tpu_info.chip_visibility_env([0, 1, 2, 3])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    env = tpu_info.chip_visibility_env([4, 5])
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+
+
+def test_chip_visibility_env_cpu_simulation():
+    env = tpu_info.chip_visibility_env([], platform="cpu", simulate_chips=8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "device_count=8" in env["XLA_FLAGS"]
+
+
+def test_profiler_trace_writes_tensorboard_profile(tmp_path):
+    log_dir = str(tmp_path / "prof")
+
+    x = jnp.ones((64, 64))
+    f = jax.jit(lambda a: a @ a)
+
+    def step():
+        return f(x).block_until_ready()
+
+    profiling.profile_steps(log_dir, step, warmup=1, steps=2)
+    produced = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                         recursive=True)
+    assert produced, f"no xplane trace under {log_dir}"
