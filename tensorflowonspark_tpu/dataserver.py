@@ -204,22 +204,97 @@ class DataServer:
                     return ("err", f"inference produced {len(results)}/{len(items)} results "
                                    f"before {self.feed_timeout}s timeout")
             return ("ok", results)
+        if op == "ring_setup":
+            # Same-host fast path: move the request/reply stream onto a pair
+            # of native shared-memory rings (shm_ring.py).  Only offered
+            # after the TCP HMAC handshake has already authenticated the
+            # peer; the rings themselves are 0600 same-user segments.
+            try:
+                from tensorflowonspark_tpu import shm_ring
+
+                capacity = int(msg[1]) if len(msg) > 1 else 64 * 1024 * 1024
+                c2s = shm_ring.ShmRing.create(capacity=capacity)
+                s2c = shm_ring.ShmRing.create(capacity=capacity)
+            except Exception as e:  # noqa: BLE001 - no compiler/shm: stay on TCP
+                return ("err", f"ring unavailable: {e}")
+            threading.Thread(target=self._serve_ring, args=(c2s, s2c),
+                             daemon=True, name="dataserver-ring").start()
+            return ("ok", c2s.name, s2c.name)
         if op == "close":
             return ("ok",)
         return ("err", f"unknown op {op!r}")
+
+    def _serve_ring(self, c2s, s2c) -> None:
+        from tensorflowonspark_tpu.shm_ring import RingClosed, RingTimeout
+
+        try:
+            while not self._stopped.is_set():
+                try:
+                    msg = c2s.get(timeout=1.0)
+                except RingTimeout:
+                    continue
+                except RingClosed:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # noqa: BLE001 - mirror TCP behaviour
+                    logger.exception("dataserver ring op failed")
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                s2c.put(reply, timeout=None)
+                if msg[0] == "close":
+                    return
+        except (RingClosed, OSError):
+            return
+        finally:
+            s2c.close_write()
+            for ring in (c2s, s2c):
+                ring.detach()
+                ring.unlink()
 
 
 class DataClient:
     """Driver-side connection to one node's DataServer."""
 
-    def __init__(self, host: str, port: int, authkey: bytes, chunk_size: int = 512):
+    def __init__(self, host: str, port: int, authkey: bytes, chunk_size: int = 512,
+                 prefer_ring: bool = True, ring_capacity: int = 64 * 1024 * 1024):
         self.chunk_size = chunk_size
+        self.ring_capacity = ring_capacity
         self._sock = socket.create_connection((host, port), timeout=60.0)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
         if not _hmac_handshake_client(self._sock, authkey):
             self._sock.close()
             raise RuntimeError("data plane error: auth handshake failed")
+        self._c2s = self._s2c = None
+        if prefer_ring and os.environ.get("TOS_SHM_RING", "1") != "0":
+            self._try_ring_setup(host)
+
+    def _try_ring_setup(self, host: str) -> None:
+        """Upgrade to shared-memory rings when the node is on this host."""
+        from tensorflowonspark_tpu.utils.net import local_ip
+
+        if host not in ("127.0.0.1", "localhost", local_ip()):
+            return
+        try:
+            from tensorflowonspark_tpu import shm_ring
+
+            if not shm_ring.available():
+                return
+            with self._lock:
+                _send(self._sock, ("ring_setup", self.ring_capacity))
+                reply = _recv(self._sock)
+            if not (isinstance(reply, tuple) and reply[0] == "ok"):
+                return
+            self._c2s = shm_ring.ShmRing.attach(reply[1])
+            self._s2c = shm_ring.ShmRing.attach(reply[2])
+            logger.info("data plane upgraded to shm ring (%s)", reply[1])
+        except Exception:  # noqa: BLE001 - any failure: stay on TCP
+            logger.debug("shm ring setup failed; using TCP", exc_info=True)
+            self._c2s = self._s2c = None
+
+    @property
+    def using_ring(self) -> bool:
+        return self._c2s is not None
 
     def _check(self, reply: tuple) -> tuple:
         if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
@@ -228,8 +303,36 @@ class DataClient:
 
     def _call(self, msg: tuple) -> tuple:
         with self._lock:
+            if self._c2s is not None:
+                try:
+                    self._c2s.put(msg, timeout=None)
+                except (EOFError, TimeoutError, OSError, ValueError):
+                    # Send failed ⇒ the server never saw the request: safe to
+                    # downgrade to the healthy TCP socket and retry there.
+                    logger.warning("shm ring send failed; downgrading to TCP",
+                                   exc_info=True)
+                    self._teardown_ring()
+                else:
+                    try:
+                        return self._check(self._s2c.get(timeout=None))
+                    except (EOFError, TimeoutError, OSError, ValueError) as e:
+                        # Reply path failed AFTER the server may have acted:
+                        # retrying could double-feed, so surface the error.
+                        # Future calls use TCP.
+                        self._teardown_ring()
+                        raise RuntimeError(
+                            f"data plane error: ring reply lost ({e})") from e
             _send(self._sock, msg)
             return self._check(_recv(self._sock))
+
+    def _teardown_ring(self) -> None:
+        if self._c2s is not None:
+            for ring in (self._c2s, self._s2c):
+                try:
+                    ring.detach()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._c2s = self._s2c = None
 
     def feed_partition(self, items: Iterable[Any], qname: str = "input") -> str:
         """Stream one partition; returns final node state ('running'/'terminating')."""
@@ -260,6 +363,14 @@ class DataClient:
         self._call(("eof", qname))
 
     def close(self) -> None:
+        if self._c2s is not None:
+            try:
+                self._c2s.close_write()  # ring server drains, then cleans up
+                self._c2s.detach()
+                self._s2c.detach()
+            except Exception:  # noqa: BLE001
+                pass
+            self._c2s = self._s2c = None
         try:
             with self._lock:
                 _send(self._sock, ("close",))

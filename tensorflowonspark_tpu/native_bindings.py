@@ -10,35 +10,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
+
+from tensorflowonspark_tpu.native.build import build_native_lib
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native", "tfrecord_codec.cc")
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native_build")
 
-
-def _build() -> str:
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    lib_path = os.path.join(_CACHE_DIR, "libtfrecord_codec.so")
-    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC):
-        return lib_path
-    # Build into a temp file then rename: concurrent node processes may race
-    # to build; rename is atomic so everyone ends with a whole library.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE_DIR)
-    os.close(fd)
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-            check=True, capture_output=True, timeout=120,
-        )
-        os.replace(tmp, lib_path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-    return lib_path
-
-
-_lib = ctypes.CDLL(_build())
+_lib = ctypes.CDLL(build_native_lib(_SRC, "libtfrecord_codec.so"))
 
 _lib.tos_crc32c.restype = ctypes.c_uint32
 _lib.tos_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]

@@ -39,7 +39,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
         raise ValueError(f"batch {x.shape[0]} not divisible by "
                          f"n_microbatches {n_microbatches}")
     for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
-        if leaf.shape[0] != n_stages:
+        if getattr(leaf, "ndim", 0) == 0 or leaf.shape[0] != n_stages:
             raise ValueError(
                 f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
                 f"dim {leaf.shape[0]}, expected n_stages={n_stages} "

@@ -1,0 +1,215 @@
+"""Python face of the native shared-memory ring (``native/shm_ring.cc``).
+
+Same-host data-plane fast path: where the reference moved every sample
+through a ``multiprocessing`` manager proxy (TFManager queues, SURVEY.md
+§3.2), feeder and node here share a lock-free SPSC byte ring in POSIX shm —
+no sockets, no proxy, one memcpy each way.  ``DataClient`` uses it
+automatically when it detects the node is on its own host (dataserver.py);
+everything falls back to TCP when the native lib can't build.
+
+Security note: items are pickled.  The ring is 0600 in /dev/shm under a
+random name, same-user-same-host only — the same trust domain as the TCP
+path *after* its HMAC handshake, so no authentication layer is needed here.
+
+SPSC contract: one pusher process/thread, one popper.  The request/reply
+pattern uses a pair of rings (c2s, s2c).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import secrets
+from typing import Any
+
+_LIB = None
+
+
+class RingUnavailable(RuntimeError):
+    pass
+
+
+class RingClosed(EOFError):
+    pass
+
+
+class RingTimeout(TimeoutError):
+    pass
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from tensorflowonspark_tpu.native.build import build_native_lib
+
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native", "shm_ring.cc")
+        try:
+            lib = ctypes.CDLL(build_native_lib(src, "libshm_ring.so",
+                                               ("-lrt",)))
+        except Exception as e:  # noqa: BLE001 - no compiler / no shm
+            raise RingUnavailable(str(e)) from e
+        lib.tos_ring_open.restype = ctypes.c_void_p
+        lib.tos_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_int]
+        lib.tos_ring_push.restype = ctypes.c_int
+        lib.tos_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int]
+        lib.tos_ring_next_size.restype = ctypes.c_int64
+        lib.tos_ring_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tos_ring_pop.restype = ctypes.c_int64
+        lib.tos_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int]
+        for fn in ("tos_ring_close_write", "tos_ring_detach"):
+            getattr(lib, fn).restype = None
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.tos_ring_is_closed.restype = ctypes.c_int
+        lib.tos_ring_is_closed.argtypes = [ctypes.c_void_p]
+        lib.tos_ring_size.restype = ctypes.c_uint64
+        lib.tos_ring_size.argtypes = [ctypes.c_void_p]
+        lib.tos_ring_capacity.restype = ctypes.c_uint64
+        lib.tos_ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.tos_ring_unlink.restype = ctypes.c_int
+        lib.tos_ring_unlink.argtypes = [ctypes.c_char_p]
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except RingUnavailable:
+        return False
+
+
+def make_ring_name(prefix: str = "tosring") -> str:
+    return f"/{prefix}_{os.getpid()}_{secrets.token_hex(8)}"
+
+
+class ShmRing:
+    """One directional ring.  ``create()`` on the owning side, ``attach()``
+    on the peer; the creator should ``unlink()`` at teardown."""
+
+    def __init__(self, name: str, handle: int, owner: bool):
+        self.name = name
+        self._h = handle
+        self._owner = owner
+
+    @classmethod
+    def create(cls, name: str | None = None,
+               capacity: int = 64 * 1024 * 1024) -> "ShmRing":
+        name = name or make_ring_name()
+        lib = _lib()
+        lib.tos_ring_unlink(name.encode())  # clear any stale segment
+        h = lib.tos_ring_open(name.encode(), capacity, 1)
+        if not h:
+            raise RingUnavailable(f"cannot create ring {name}")
+        return cls(name, h, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        h = _lib().tos_ring_open(name.encode(), 0, 0)
+        if not h:
+            raise RingUnavailable(f"cannot attach ring {name}")
+        return cls(name, h, owner=False)
+
+    # -- raw bytes -----------------------------------------------------------
+    #
+    # Wire format: every ring record is 1 flag byte + payload.  Messages
+    # larger than the ring are transparently segmented (WHOLE | MORE… LAST);
+    # SPSC ordering guarantees segments arrive contiguously.  NB: a timeout
+    # raised mid-segmented-put leaves a partial message in flight — callers
+    # must treat RingTimeout as fatal for the ring (downgrade transport).
+
+    _WHOLE, _MORE, _LAST = b"\x00", b"\x01", b"\x02"
+
+    @property
+    def capacity(self) -> int:
+        return _lib().tos_ring_capacity(self._h)
+
+    def _push_record(self, record: bytes, timeout: float | None) -> None:
+        rc = _lib().tos_ring_push(self._h, record, len(record),
+                                  -1 if timeout is None else int(timeout * 1000))
+        if rc == 1:
+            return
+        if rc == 0:
+            raise RingTimeout(f"push timed out after {timeout}s")
+        if rc == -1:
+            raise RingClosed("ring closed")
+        raise ValueError(f"record of {len(record)} bytes exceeds ring capacity")
+
+    def put_bytes(self, data: bytes, timeout: float | None = 600.0) -> None:
+        max_payload = self.capacity // 2  # headroom so a segment always fits
+        if len(data) <= max_payload:
+            self._push_record(self._WHOLE + data, timeout)
+            return
+        for start in range(0, len(data), max_payload):
+            seg = data[start:start + max_payload]
+            last = start + max_payload >= len(data)
+            self._push_record((self._LAST if last else self._MORE) + seg,
+                              timeout)
+
+    def _pop_record(self, timeout: float | None) -> bytes:
+        lib = _lib()
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        size = lib.tos_ring_next_size(self._h, tmo)
+        if size == -1:
+            raise RingClosed("ring closed and drained")
+        if size == -3:
+            raise RingTimeout(f"pop timed out after {timeout}s")
+        buf = ctypes.create_string_buffer(int(size))
+        n = lib.tos_ring_pop(self._h, buf, int(size), tmo)
+        if n == -1:
+            raise RingClosed("ring closed and drained")
+        if n == -3:
+            raise RingTimeout(f"pop timed out after {timeout}s")
+        assert n == size, (n, size)
+        return buf.raw[:int(n)]
+
+    def get_bytes(self, timeout: float | None = 600.0) -> bytes:
+        rec = self._pop_record(timeout)
+        flag, payload = rec[:1], rec[1:]
+        if flag == self._WHOLE:
+            return payload
+        parts = [payload]
+        while flag == self._MORE:
+            rec = self._pop_record(timeout)
+            flag, payload = rec[:1], rec[1:]
+            parts.append(payload)
+        if flag != self._LAST:
+            raise ValueError(f"corrupt ring stream: unexpected flag {flag!r}")
+        return b"".join(parts)
+
+    # -- pickled objects -----------------------------------------------------
+
+    def put(self, obj: Any, timeout: float | None = 600.0) -> None:
+        self.put_bytes(pickle.dumps(obj, pickle.HIGHEST_PROTOCOL), timeout)
+
+    def get(self, timeout: float | None = 600.0) -> Any:
+        return pickle.loads(self.get_bytes(timeout))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close_write(self) -> None:
+        """Producer hangs up; consumers drain then see RingClosed."""
+        _lib().tos_ring_close_write(self._h)
+
+    @property
+    def pending_bytes(self) -> int:
+        return _lib().tos_ring_size(self._h)
+
+    def detach(self) -> None:
+        if self._h:
+            _lib().tos_ring_detach(self._h)
+            self._h = 0
+
+    def unlink(self) -> None:
+        _lib().tos_ring_unlink(self.name.encode())
+
+    def __del__(self):  # best-effort; explicit detach preferred
+        try:
+            self.detach()
+        except Exception:
+            pass

@@ -43,13 +43,16 @@ def device_summary() -> dict:
     try:
         import jax
 
-        devices = jax.devices()
+        # local_devices/process_index, NOT jax.devices(): after
+        # jax.distributed.initialize the latter is pod-global, and every node
+        # would report the whole pod's chips instead of its own.
+        devices = jax.local_devices()
         return {
             "platform": jax.default_backend(),
             "device_kind": devices[0].device_kind if devices else "none",
             "num_devices": len(devices),
             "coords": [list(getattr(d, "coords", ()) or ()) for d in devices],
-            "process_index": getattr(devices[0], "process_index", 0) if devices else 0,
+            "process_index": jax.process_index(),
         }
     except Exception:
         return {"platform": "none", "device_kind": "none", "num_devices": 0,

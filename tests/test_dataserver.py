@@ -92,3 +92,104 @@ def test_infer_timeout_when_model_absent():
         client.infer_partition([1, 2, 3])
     client.close()
     server.stop()
+
+
+def test_ring_upgrade_engages_on_localhost():
+    from tensorflowonspark_tpu import shm_ring
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring not buildable")
+    queues, server, client = start_pair()
+    assert client.using_ring
+    feed = DataFeed(queues)
+    client.feed_partition(range(50))
+    client.send_eof()
+    assert feed.next_batch(100) == list(range(50))
+    client.close()
+    server.stop()
+
+
+def test_tcp_path_still_works_when_ring_disabled():
+    queues = FeedQueues(capacity=1024)
+    server = DataServer(queues, AUTH, feed_timeout=5.0)
+    port = server.start()
+    client = DataClient("127.0.0.1", port, AUTH, chunk_size=8, prefer_ring=False)
+    assert not client.using_ring
+    feed = DataFeed(queues)
+    client.feed_partition(range(10))
+    client.send_eof()
+    assert feed.next_batch(100) == list(range(10))
+    client.close()
+    server.stop()
+
+
+def test_oversized_messages_stream_through_ring():
+    # Chunks (and replies) larger than the ring are segmented transparently
+    # in both directions; the client stays on the ring throughout.
+    from tensorflowonspark_tpu import shm_ring
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring not buildable")
+    queues = FeedQueues(capacity=1024)
+    server = DataServer(queues, AUTH, feed_timeout=5.0)
+    port = server.start()
+    client = DataClient("127.0.0.1", port, AUTH, chunk_size=4,
+                        ring_capacity=64 * 1024)
+    assert client.using_ring
+    feed = DataFeed(queues)
+    big = b"B" * (200 * 1024)  # one chunk of these exceeds the 64k ring
+    client.feed_partition([big, big, b"small"])
+    client.send_eof()
+    got = feed.next_batch(10)
+    assert got == [big, big, b"small"]
+    assert client.using_ring  # never downgraded
+    client.close()
+    server.stop()
+
+    # Fresh pair for the reply direction (the EOF above still sits in the
+    # old input queue): replies larger than the ring segment too.
+    queues2 = FeedQueues(capacity=1024)
+    server2 = DataServer(queues2, AUTH, feed_timeout=5.0)
+    client2 = DataClient("127.0.0.1", server2.start(), AUTH, chunk_size=4,
+                         ring_capacity=64 * 1024)
+    assert client2.using_ring
+
+    def model():
+        f = DataFeed(queues2, train_mode=False)
+        while not f.should_stop():
+            batch = f.next_batch(4)
+            if batch:
+                f.batch_results([x * 3 for x in batch])  # replies > ring too
+
+    t = threading.Thread(target=model, daemon=True)
+    t.start()
+    assert client2.infer_partition([big, b"x"]) == [big * 3, b"xxx"]
+    assert client2.using_ring
+    client2.send_eof()
+    t.join(5)
+    client2.close()
+    server2.stop()
+
+
+def test_ring_inference_roundtrip():
+    from tensorflowonspark_tpu import shm_ring
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring not buildable")
+    queues, server, client = start_pair()
+    assert client.using_ring
+
+    def model():
+        feed = DataFeed(queues, train_mode=False)
+        while not feed.should_stop():
+            batch = feed.next_batch(4)
+            if batch:
+                feed.batch_results([x + 1 for x in batch])
+
+    t = threading.Thread(target=model, daemon=True)
+    t.start()
+    assert client.infer_partition(list(range(40))) == [x + 1 for x in range(40)]
+    client.send_eof()
+    t.join(5)
+    client.close()
+    server.stop()
