@@ -67,6 +67,8 @@ class Attention(nn.Module):
     attn_impl: str = "auto"       # auto | pallas | xla | reference | ring | ulysses
     mesh: Optional[Any] = None    # required for ring/ulysses
     compute_dtype: Any = jnp.bfloat16
+    decode: bool = False          # autoregressive single-token mode (KV cache)
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -76,6 +78,8 @@ class Attention(nn.Module):
             (h, dh), axis=-1, use_bias=False, name=name,
             dtype=self.compute_dtype)
         q, k, v = dense("q_proj")(x), dense("k_proj")(x), dense("v_proj")(x)
+        if self.decode:
+            return self._decode_step(x, q, k, v)
         positions = jnp.arange(s)
         q = apply_rope(q, positions, self.rope_theta)
         k = apply_rope(k, positions, self.rope_theta)
@@ -96,6 +100,43 @@ class Attention(nn.Module):
         out = nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
                               name="o_proj", dtype=self.compute_dtype)(out)
         return out
+
+    def _decode_step(self, x, q, k, v):
+        """One token through a static-size KV cache (``cache`` collection).
+
+        Static shapes throughout — the cache is ``[B, max_decode_len, H, D]``
+        and masking does the rest, so the whole decode loop jits once.
+        """
+        if self.max_decode_len <= 0:
+            raise ValueError("decode mode needs max_decode_len > 0")
+        b, s, h, dh = q.shape
+        if s != 1:
+            raise ValueError(f"decode mode is single-token (got seq {s})")
+        L = self.max_decode_len
+        ck = self.variable("cache", "k", jnp.zeros, (b, L, h, dh),
+                           self.compute_dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (b, L, h, dh),
+                           self.compute_dtype)
+        idx = self.variable("cache", "index",
+                            lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        pos = cur[None]  # RoPE position of this token
+        q = apply_rope(q, pos, self.rope_theta)
+        k = apply_rope(k, pos, self.rope_theta)
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        idx.value = cur + 1
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            ck.value.astype(jnp.float32))
+        logits = logits / math.sqrt(dh)
+        mask = jnp.arange(L)[None, None, None, :] <= cur
+        logits = jnp.where(mask, logits, -1e30)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights,
+                         cv.value.astype(jnp.float32))
+        out = out.astype(self.compute_dtype)
+        return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
+                               name="o_proj", dtype=self.compute_dtype)(out)
 
 
 class SwiGLU(nn.Module):
@@ -122,11 +163,14 @@ class Block(nn.Module):
     attn_impl: str = "auto"
     mesh: Optional[Any] = None
     compute_dtype: Any = jnp.bfloat16
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, x):
         x = x + Attention(self.n_heads, self.d_head, self.rope_theta,
                           self.attn_impl, self.mesh, self.compute_dtype,
+                          self.decode, self.max_decode_len,
                           name="attn")(RMSNorm(name="attn_norm")(x))
         x = constrain(x, P(BATCH, "sp", None))
         if self.n_experts:
@@ -156,6 +200,8 @@ class Transformer(nn.Module):
     attn_impl: str = "auto"
     mesh: Optional[Any] = None
     compute_dtype: Any = jnp.bfloat16
+    decode: bool = False
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, input_ids):
@@ -168,7 +214,8 @@ class Transformer(nn.Module):
         for i in range(self.n_layers):
             x = Block(self.n_heads, dh, dff, self.n_experts, self.moe_top_k,
                       self.rope_theta, self.attn_impl, self.mesh,
-                      self.compute_dtype, name=f"block_{i}")(x)
+                      self.compute_dtype, self.decode, self.max_decode_len,
+                      name=f"block_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, name="lm_head",
                           dtype=self.compute_dtype)(x)
