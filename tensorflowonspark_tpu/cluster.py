@@ -95,7 +95,9 @@ class TPUCluster:
     def _client(self, executor_id: int) -> DataClient:
         if executor_id not in self._clients:
             meta = self.cluster_info[executor_id]
-            self._clients[executor_id] = DataClient(meta["host"], meta["data_port"], self.authkey)
+            self._clients[executor_id] = DataClient(
+                meta["host"], meta["data_port"], self.authkey,
+                call_timeout=self.feed_timeout + 60.0)
         return self._clients[executor_id]
 
     # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------

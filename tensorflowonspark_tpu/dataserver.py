@@ -34,6 +34,7 @@ import queue
 import socket
 import struct
 import threading
+from time import monotonic as _monotonic
 from typing import Any, Iterable
 
 from tensorflowonspark_tpu.feeding import FeedQueues
@@ -227,6 +228,7 @@ class DataServer:
     def _serve_ring(self, c2s, s2c) -> None:
         from tensorflowonspark_tpu.shm_ring import RingClosed, RingTimeout
 
+        unlinked = False
         try:
             while not self._stopped.is_set():
                 try:
@@ -235,12 +237,50 @@ class DataServer:
                     continue
                 except RingClosed:
                     return
+                if not unlinked:
+                    # First message proves the client has mmap'd both rings:
+                    # unlink the names eagerly so the segments can never
+                    # outlive the processes (POSIX shm persists past process
+                    # death until unlinked — 2x capacity leaked per abandoned
+                    # pair otherwise).
+                    c2s.unlink()
+                    s2c.unlink()
+                    unlinked = True
                 try:
                     reply = self._handle(msg)
                 except Exception as e:  # noqa: BLE001 - mirror TCP behaviour
                     logger.exception("dataserver ring op failed")
                     reply = ("err", f"{type(e).__name__}: {e}")
-                s2c.put(reply, timeout=None)
+                # Bounded reply put: a client that detached without draining
+                # would otherwise pin this thread (and the finally-cleanup)
+                # forever.  Retry-with-short-timeout is only safe for a
+                # single WHOLE record (a timed-out push commits nothing);
+                # a segmented put that times out mid-stream leaves partial
+                # segments in flight (shm_ring contract) — one bounded
+                # attempt, then abandon the ring.
+                data = pickle.dumps(reply, protocol=pickle.HIGHEST_PROTOCOL)
+                if len(data) + 1 <= s2c.capacity // 2:
+                    sent = False
+                    deadline = _monotonic() + self.feed_timeout
+                    while not sent and not self._stopped.is_set():
+                        try:
+                            s2c.put_bytes(data, timeout=5.0)
+                            sent = True
+                        except RingTimeout:
+                            if _monotonic() > deadline:
+                                logger.warning(
+                                    "ring client not draining replies for "
+                                    "%.0fs; abandoning ring", self.feed_timeout)
+                                return
+                    if not sent:
+                        return
+                else:
+                    try:
+                        s2c.put_bytes(data, timeout=self.feed_timeout)
+                    except RingTimeout:
+                        logger.warning("ring client not draining a segmented "
+                                       "reply; abandoning ring")
+                        return
                 if msg[0] == "close":
                     return
         except (RingClosed, OSError):
@@ -249,16 +289,24 @@ class DataServer:
             s2c.close_write()
             for ring in (c2s, s2c):
                 ring.detach()
-                ring.unlink()
+                if not unlinked:
+                    ring.unlink()
 
 
 class DataClient:
     """Driver-side connection to one node's DataServer."""
 
     def __init__(self, host: str, port: int, authkey: bytes, chunk_size: int = 512,
-                 prefer_ring: bool = True, ring_capacity: int = 64 * 1024 * 1024):
+                 prefer_ring: bool = True, ring_capacity: int = 64 * 1024 * 1024,
+                 call_timeout: float = 660.0):
         self.chunk_size = chunk_size
         self.ring_capacity = ring_capacity
+        # Ring-path request/reply timeout.  Must exceed the server's
+        # feed_timeout (its puts can legitimately block that long under
+        # backpressure) but must be finite: if the node process is SIGKILLed
+        # the ring's closed flag is never set, and an infinite wait would
+        # wedge the whole driver data plane inside self._lock.
+        self.call_timeout = call_timeout
         self._sock = socket.create_connection((host, port), timeout=60.0)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
@@ -305,7 +353,7 @@ class DataClient:
         with self._lock:
             if self._c2s is not None:
                 try:
-                    self._c2s.put(msg, timeout=None)
+                    self._c2s.put(msg, timeout=self.call_timeout)
                 except (EOFError, TimeoutError, OSError, ValueError):
                     # Send failed ⇒ the server never saw the request: safe to
                     # downgrade to the healthy TCP socket and retry there.
@@ -314,7 +362,7 @@ class DataClient:
                     self._teardown_ring()
                 else:
                     try:
-                        return self._check(self._s2c.get(timeout=None))
+                        return self._check(self._s2c.get(timeout=self.call_timeout))
                     except (EOFError, TimeoutError, OSError, ValueError) as e:
                         # Reply path failed AFTER the server may have acted:
                         # retrying could double-feed, so surface the error.

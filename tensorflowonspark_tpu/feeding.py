@@ -102,6 +102,8 @@ class DataFeed:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping: dict[str, str] | None = None,
+        stop_event: threading.Event | None = None,
+        poll_interval: float = 0.25,
     ):
         self.queues = queues
         self.train_mode = train_mode
@@ -109,6 +111,12 @@ class DataFeed:
         self.qname_out = qname_out
         self.input_mapping = input_mapping
         self.done_feeding = False
+        # Liveness: a bare q.get() would wedge map_fun forever if the driver
+        # dies between partitions (zombie-free design goal, SURVEY.md §7.3-5).
+        # next_batch polls at poll_interval and treats a set stop_event as
+        # end-of-feed.
+        self.stop_event = stop_event
+        self.poll_interval = poll_interval
 
     # -- consuming -----------------------------------------------------------
 
@@ -120,7 +128,13 @@ class DataFeed:
         q = self.queues.get_queue(self.qname_in)
         batch: list = []
         while len(batch) < batch_size:
-            item = q.get()
+            try:
+                item = q.get(timeout=self.poll_interval)
+            except queue.Empty:
+                if self.stop_event is not None and self.stop_event.is_set():
+                    self.done_feeding = True
+                    break
+                continue
             if isinstance(item, EndPartition):
                 if batch:
                     break  # partial batch closes out the partition

@@ -105,7 +105,8 @@ class NodeContext:
         input_mapping: dict | None = None,
     ) -> DataFeed:
         """Reference: ``TFNode.DataFeed(ctx.mgr, ...)`` (``TFNode.py:~250``)."""
-        return DataFeed(self.queues, train_mode, qname_in, qname_out, input_mapping)
+        return DataFeed(self.queues, train_mode, qname_in, qname_out, input_mapping,
+                        stop_event=self.stop_requested)
 
     # -- path plumbing -------------------------------------------------------
 
@@ -268,7 +269,17 @@ def node_main(config: NodeConfig) -> int:
             except Exception:
                 failures += 1
                 if failures >= 3:
-                    return  # coordinator gone; driver exited
+                    # Coordinator gone (driver exited/crashed): treat exactly
+                    # like a stop signal so map_fun unblocks instead of
+                    # wedging on the feed until the launcher SIGTERMs us
+                    # (reference feed_timeout semantics,
+                    # TFSparkNode.py:~460-490).
+                    logger.warning("coordinator unreachable after %d heartbeats; "
+                                   "forcing end-of-feed", failures)
+                    ctx.stop_requested.set()
+                    for qname in config.input_qnames:
+                        _force_put(queues.get_queue(qname), EndOfFeed())
+                    return
                 stop = False
             if stop:
                 # Driver asked us to stop: unblock any DataFeed consumer so

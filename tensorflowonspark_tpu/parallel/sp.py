@@ -68,7 +68,11 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
         return (o, lse, k_nxt, v_nxt), None
 
     b, s, h, d = q.shape
-    o0 = match_vma(jnp.zeros((b, s, h, d), q.dtype), q)
+    # The accumulator stays float32 through every merge (merge_attention
+    # preserves o1's dtype): a bf16 carry would round after each hop and
+    # precision would degrade with ring size relative to the f32
+    # accumulation used everywhere else in ops/attention.py.
+    o0 = match_vma(jnp.zeros((b, s, h, d), jnp.float32), q)
     lse0 = match_vma(jnp.full((b, s, h), -jnp.inf, jnp.float32), q)
     # n-1 hops rotate KV while attending; the final held chunk is attended
     # outside the scan so its rotation (whose result nobody reads) is never
@@ -76,7 +80,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     (o, lse, k_last, v_last), _ = jax.lax.scan(
         step, (o0, lse0, k, v), jnp.arange(n - 1, dtype=jnp.int32))
     o, lse = attend_held(o, lse, k_last, v_last, jnp.int32(n - 1))
-    return o
+    return o.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,

@@ -160,7 +160,10 @@ class ShmRing:
         if size == -3:
             raise RingTimeout(f"pop timed out after {timeout}s")
         buf = ctypes.create_string_buffer(int(size))
-        n = lib.tos_ring_pop(self._h, buf, int(size), tmo)
+        # next_size succeeded ⇒ the record is already available to this (the
+        # only) consumer; pop non-blockingly so the two calls can't stack up
+        # to 2x the requested timeout per record.
+        n = lib.tos_ring_pop(self._h, buf, int(size), 0)
         if n == -1:
             raise RingClosed("ring closed and drained")
         if n == -3:

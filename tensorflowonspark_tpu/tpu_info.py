@@ -104,7 +104,8 @@ def default_mesh_axes(n_chips: int, *, model_parallel: int = 1) -> dict:
 
 
 def chip_visibility_env(chip_ids: Sequence[int], *, platform: str = "tpu",
-                        simulate_chips: int | None = None) -> dict[str, str]:
+                        simulate_chips: int | None = None,
+                        bounds: str | None = None) -> dict[str, str]:
     """Env for a child process that must see only ``chip_ids``.
 
     On TPU hosts this is the ``CUDA_VISIBLE_DEVICES`` analogue
@@ -112,6 +113,12 @@ def chip_visibility_env(chip_ids: Sequence[int], *, platform: str = "tpu",
     convention for carving a host's chips between processes).  With
     ``platform='cpu'`` it returns the virtual-device simulation env used by
     tests and the multi-process local launcher.
+
+    ``bounds`` overrides ``TPU_CHIPS_PER_PROCESS_BOUNDS`` ("x,y,z").  Pass it
+    whenever real host topology is known (e.g. derived from discovered device
+    coords — v2/v3 hosts are ``2,2,1``); without it the value is a
+    *best-effort guess* (square grid, else ``1,n,1``) which libtpu may reject
+    or mis-map on hosts whose physical layout differs.
     """
     if platform == "cpu":
         n = simulate_chips if simulate_chips is not None else len(chip_ids)
@@ -121,13 +128,36 @@ def chip_visibility_env(chip_ids: Sequence[int], *, platform: str = "tpu",
         }
     ids = ",".join(str(int(c)) for c in chip_ids)
     n = len(chip_ids)
-    side = max(1, int(math.isqrt(n)))
-    if side * side != n:
-        side = 1  # non-square slice: 1 x n bounds
-    bounds = f"{side},{n // side},1"
+    if bounds is None:
+        side = max(1, int(math.isqrt(n)))
+        if side * side != n:
+            side = 1  # non-square slice: 1 x n bounds
+        bounds = f"{side},{n // side},1"
     return {
         "TPU_VISIBLE_CHIPS": ids,
         "TPU_CHIPS_PER_PROCESS_BOUNDS": bounds,
         "TPU_PROCESS_BOUNDS": "1,1,1",
         "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
     }
+
+
+def bounds_from_coords(coords: Sequence[Sequence[int]]) -> str | None:
+    """Derive ``TPU_CHIPS_PER_PROCESS_BOUNDS`` from discovered device coords
+    (``device_summary()["coords"]``).
+
+    Returns None when coords are unavailable, malformed, or do not form a
+    dense axis-aligned box (a non-contiguous chip selection has no valid
+    bounds string — the span's volume would disagree with the chip count and
+    libtpu would mis-map).
+    """
+    if not coords:
+        return None
+    pts = {tuple(int(x) for x in c) for c in coords}
+    if len(pts) != len(list(coords)) or any(len(p) != 3 for p in pts):
+        return None
+    lo = [min(p[i] for p in pts) for i in range(3)]
+    hi = [max(p[i] for p in pts) for i in range(3)]
+    span = [hi[i] - lo[i] + 1 for i in range(3)]
+    if span[0] * span[1] * span[2] != len(pts):
+        return None  # holes: the selection is not a dense box
+    return ",".join(str(s) for s in span)
