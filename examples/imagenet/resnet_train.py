@@ -64,15 +64,18 @@ def main() -> None:
 
     ckpt = CheckpointManager(args.model_dir) if args.model_dir else None
     if ckpt is not None:
-        restored = ckpt.restore_latest({"params": state.params,
-                                        "batch_stats": state.batch_stats})
+        # Full train state: a restart resumes with momentum and the true
+        # step counter, not just weights (SURVEY.md §5.4).
+        restored = ckpt.restore_latest(state._asdict())
         if restored is not None:
-            import jax.numpy as jnp
-
             tree, step_no = restored
-            state = state._replace(params=tree["params"],
-                                   batch_stats=tree["batch_stats"],
-                                   step=state.step + jnp.int32(step_no))
+            # Restore hands back host arrays; re-place every leaf under the
+            # sharding the live state already has (fsdp params must go back
+            # sharded, not materialize full-size on every device).
+            placed = jax.tree.map(
+                lambda x, live: jax.device_put(np.asarray(x), live.sharding),
+                tree, state._asdict())
+            state = dplib.BNTrainState(**placed)
             print(f"restored checkpoint at step {step_no}")
 
     step_fn = dplib.make_bn_train_step(
@@ -137,8 +140,8 @@ def main() -> None:
               f"({imgs:,.0f} images/sec, {imgs / mesh.size:,.0f}/chip)")
         if ckpt is not None:
             ckpt.save(int(jax.device_get(state.step)),
-                      {"params": state.params,
-                       "batch_stats": state.batch_stats})
+                      jax.device_get(state)._asdict())
+            ckpt.wait()
             print("checkpoint saved")
 
 

@@ -24,7 +24,7 @@ import optax
 
 def main_fun(args, ctx):
     """map_fun executed on every node (reference signature: main_fun(args, ctx))."""
-    from tensorflowonspark_tpu.checkpoint import CheckpointManager, export_bundle
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager, chief_save, export_bundle
     from tensorflowonspark_tpu.models import mnist
     from tensorflowonspark_tpu.parallel.dp import TrainState, make_batch_iterator, make_train_step, replicate
     from tensorflowonspark_tpu.summary import SummaryWriter
@@ -38,13 +38,15 @@ def main_fun(args, ctx):
 
     mesh = ctx.make_mesh(dp=-1)
     state = TrainState.create(params, optimizer)
-    # Whole-job restart picks up the latest checkpoint (the reference's
+    manager = CheckpointManager(args["model_dir"]) if args.get("model_dir") else None
+    # Whole-job restart picks up the latest checkpoint — FULL train state, so
+    # momentum and the step counter survive the restart (the reference's
     # recovery contract: fail-fast + restart from checkpoint, SURVEY.md §5.3).
-    if args.get("model_dir"):
-        restored = CheckpointManager(args["model_dir"]).restore_latest({"params": state.params})
+    if manager is not None:
+        restored = manager.restore_latest(state._asdict())
         if restored is not None:
-            tree, step_no = restored
-            state = state._replace(params=tree["params"], step=state.step + step_no)
+            tree, _step_no = restored
+            state = TrainState(**tree)
     state = replicate(state, mesh)
     step = make_train_step(mnist.make_loss_fn(model), optimizer)
 
@@ -55,6 +57,7 @@ def main_fun(args, ctx):
 
     feed = ctx.get_data_feed(train_mode=True)
     last_metrics = {}
+    ckpt_every = int(args.get("checkpoint_every", 0) or 0)
     for batch, _n in make_batch_iterator(
         feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx
     ):
@@ -62,11 +65,17 @@ def main_fun(args, ctx):
         step_no = int(state.step)
         if writer and step_no % args.get("log_every", 10) == 0:
             writer.add_scalars({k: float(v) for k, v in metrics.items()}, step_no)
+        # Periodic saves are chief-local and async — no barrier: under
+        # STREAMING feeds nodes step at different rates, so a mid-loop
+        # collective would deadlock.  The coordinated chief_save below runs
+        # after the all_done consensus, where every node is aligned.
+        if manager is not None and is_chief and ckpt_every and step_no % ckpt_every == 0:
+            manager.save(step_no, jax.device_get(state)._asdict())
         last_metrics = metrics
 
+    if manager is not None:
+        chief_save(ctx, manager, int(state.step), jax.device_get(state)._asdict())
     if is_chief:
-        if args.get("model_dir"):
-            CheckpointManager(args["model_dir"]).save(int(state.step), {"params": state.params})
         if args.get("export_dir"):
             export_bundle(args["export_dir"], state.params, model_config)
         if writer:

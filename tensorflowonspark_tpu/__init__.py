@@ -26,6 +26,11 @@ __version__ = "0.1.0"
 
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
 from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
+from tensorflowonspark_tpu.launcher import (  # noqa: F401
+    LocalLauncher,
+    SubprocessLauncher,
+    TPUPodLauncher,
+)
 from tensorflowonspark_tpu.data import PartitionedDataset  # noqa: F401
 from tensorflowonspark_tpu.pipeline import (  # noqa: F401
     Namespace,

@@ -28,23 +28,72 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(path: str, tree: Any, force: bool = True) -> str:
-    """Save a pytree checkpoint to a (possibly hdfs://-mapped) path."""
+_ASYNC_CKPTR = None
+
+
+def _async_checkpointer():
+    """Process-wide async checkpointer (orbax serializes to a background
+    thread pool; the train loop keeps stepping while bytes hit disk —
+    SURVEY.md §5.4 'sharded, async')."""
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def wait_for_saves() -> None:
+    """Block until every in-flight async checkpoint save has committed."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def save_checkpoint(path: str, tree: Any, force: bool = True,
+                    async_save: bool = False) -> str:
+    """Save a pytree checkpoint to a (possibly hdfs://-mapped) path.
+
+    ``async_save=True`` returns as soon as the tree is snapshotted to host
+    memory; call ``wait_for_saves()`` (or ``CheckpointManager.wait()``)
+    before reading the checkpoint back or exiting the process.
+    """
     local = os.path.abspath(resolve_uri(path))
-    _checkpointer().save(local, tree, force=force)
+    ckptr = _async_checkpointer() if async_save else _checkpointer()
+    ckptr.save(local, tree, force=force)
     return local
 
 
 def restore_checkpoint(path: str, target: Any | None = None) -> Any:
-    """Restore a pytree; ``target`` (a matching pytree) restores dtypes/shapes
-    and device placement exactly."""
-    local = os.path.abspath(resolve_uri(path))
-    import orbax.checkpoint as ocp
+    """Restore a pytree; ``target`` (a matching pytree) recovers the exact
+    container structure (NamedTuples, tuples) that serialization flattened.
 
-    if target is not None:
-        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
-        return _checkpointer().restore(local, restore_args=restore_args)
-    return _checkpointer().restore(local)
+    Orbax canonicalizes tuples/NamedTuples (optax states are full of them) to
+    lists on disk, so the raw restore comes back list-shaped; re-flattening
+    into the target's treedef restores the real types.  Leaf order is stable
+    under that canonicalization (both sides sort dict keys), and a count or
+    shape mismatch means the checkpoint doesn't belong to this model — fail
+    loudly rather than load garbage.
+    """
+    local = os.path.abspath(resolve_uri(path))
+    raw = _checkpointer().restore(local)
+    if target is None:
+        return raw
+    import jax
+
+    leaves = jax.tree.leaves(raw)
+    treedef = jax.tree.structure(target)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint at {path} has {len(leaves)} leaves but the restore "
+            f"target expects {treedef.num_leaves} — wrong model/optimizer?")
+    for got, want in zip(leaves, jax.tree.leaves(target)):
+        gs = getattr(got, "shape", ())
+        ws = getattr(want, "shape", ())
+        if tuple(gs) != tuple(ws):
+            raise ValueError(
+                f"checkpoint leaf shape {tuple(gs)} != target shape {tuple(ws)} "
+                f"at {path}")
+    return jax.tree.unflatten(treedef, leaves)
 
 
 def _step_dirs(model_dir: str) -> list[tuple[int, str]]:
@@ -65,31 +114,69 @@ def latest_step_dir(model_dir: str) -> str | None:
 
 
 class CheckpointManager:
-    """Step-indexed checkpoints under one model_dir (keeps the newest K)."""
+    """Step-indexed checkpoints under one model_dir (keeps the newest K).
 
-    def __init__(self, model_dir: str, max_to_keep: int = 3):
+    Saves are **async by default**: the device→host snapshot happens before
+    ``save`` returns (so the train loop may donate/overwrite its state), and
+    serialization overlaps subsequent steps.  Orbax commits atomically
+    (write-to-tmp + rename), so a crash mid-save never leaves a readable
+    partial ``step_N`` directory and ``restore_latest`` only ever sees
+    complete checkpoints.
+    """
+
+    def __init__(self, model_dir: str, max_to_keep: int = 3, async_save: bool = True):
         self.model_dir = model_dir
         self.max_to_keep = max_to_keep
+        self.async_save = async_save
         os.makedirs(resolve_uri(model_dir), exist_ok=True)
 
     def save(self, step: int, tree: Any) -> str:
         path = os.path.join(self.model_dir, f"step_{int(step)}")
-        save_checkpoint(path, tree)
-        self._gc()
+        save_checkpoint(path, tree, async_save=self.async_save)
+        self._gc(pending_step=int(step))
         return path
 
+    def wait(self) -> None:
+        """Block until in-flight async saves are committed."""
+        wait_for_saves()
+
     def restore_latest(self, target: Any | None = None) -> tuple[Any, int] | None:
+        wait_for_saves()  # an in-flight save may be the latest step
         dirs = _step_dirs(self.model_dir)
         if not dirs:
             return None
         step, path = dirs[-1]
         return restore_checkpoint(path, target), step
 
-    def _gc(self) -> None:
+    def _gc(self, pending_step: int | None = None) -> None:
         import shutil
 
-        for _, path in _step_dirs(self.model_dir)[: -self.max_to_keep]:
+        # Only committed dirs appear in _step_dirs; an async save still in
+        # flight is invisible, so count it explicitly (``pending_step``) or
+        # the keep-K window would run one checkpoint too large.
+        dirs = _step_dirs(self.model_dir)
+        pending = 1 if (pending_step is not None
+                        and pending_step not in [s for s, _ in dirs]) else 0
+        excess = len(dirs) + pending - self.max_to_keep
+        for _, path in dirs[: max(0, excess)]:
             shutil.rmtree(resolve_uri(path), ignore_errors=True)
+
+
+def chief_save(ctx, manager: CheckpointManager, step: int, tree: Any,
+               timeout: float = 600.0) -> None:
+    """Multi-host save coordination: the chief writes, everyone barriers.
+
+    Correct for replicated train state (every host holds the full value;
+    N hosts writing the same bytes would race on the commit rename —
+    reference's equivalent hazard: every Spark executor writing the same
+    HDFS SavedModel path).  The barrier releases only after the chief's
+    save has *committed*, so a host that crashes right after this call
+    can still restart from the step just written.
+    """
+    if ctx.executor_id == 0:
+        manager.save(step, tree)
+        manager.wait()
+    ctx.barrier("checkpoint", timeout=timeout)
 
 
 # -- inference bundles (SavedModel analogue) ---------------------------------

@@ -97,7 +97,8 @@ class TPUCluster:
             meta = self.cluster_info[executor_id]
             self._clients[executor_id] = DataClient(
                 meta["host"], meta["data_port"], self.authkey,
-                call_timeout=self.feed_timeout + 60.0)
+                call_timeout=self.feed_timeout + 60.0,
+                stall_timeout=self.feed_timeout)
         return self._clients[executor_id]
 
     # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------
@@ -140,41 +141,102 @@ class TPUCluster:
 
     # -- inference (reference TFCluster.inference :~130-170, §3.3) -----------
 
-    def inference(self, data: Any, qname_in: str = "input", qname_out: str = "output") -> list:
+    def inference(self, data: Any, qname_in: str = "input", qname_out: str = "output",
+                  flat: bool = True) -> list:
         """Round-trip partitions through the nodes; ordered, exactly-count.
 
         Returns the flattened results in partition order — the invariant the
-        reference's output RDD preserved (SURVEY.md §3.3).
+        reference's output RDD preserved (SURVEY.md §3.3).  ``flat=False``
+        returns one result list per partition instead (the pipeline layer
+        needs partition boundaries to rebuild a PartitionedDataset).
+
+        Materializes everything; for datasets bigger than driver memory use
+        ``inference_stream``.
+        """
+        dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+        results: list[list | None] = [None] * dataset.num_partitions
+        for p, part in self.inference_stream(dataset, qname_in, qname_out,
+                                             window=dataset.num_partitions + 1):
+            results[p] = part
+        if not flat:
+            return [part or [] for part in results]
+        return [item for part in results for item in (part or [])]
+
+    def inference_stream(self, data: Any, qname_in: str = "input",
+                         qname_out: str = "output", window: int | None = None):
+        """Lazily yield ``(partition_index, results)`` in partition order.
+
+        Restores the reference's lazy-RDD property
+        (``TFCluster.py:~130-170``): partitions are read, scored, and yielded
+        incrementally, so driver memory holds at most ``window`` completed
+        partitions (default ``2 × feedable nodes``) — workers pause instead
+        of running ahead of the consumer.
         """
         if self.input_mode != InputMode.STREAMING:
             raise RuntimeError(
-                "inference(data) requires InputMode.STREAMING (reference: InputMode.SPARK); "
+                "inference requires InputMode.STREAMING (reference: InputMode.SPARK); "
                 "DIRECT-mode map_funs read files themselves and never consume the feed"
             )
         dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
-        results: list[list | None] = [None] * dataset.num_partitions
+        num_workers = len(self._feed_ids)
+        window = window if window is not None else max(2 * num_workers, 4)
+        buf: dict[int, list] = {}
+        cond = threading.Condition()
+        state = {"next": 0, "stopped": False, "done": 0}
         errors: list[Exception] = []
 
         def _infer_worker(worker_pos: int, executor_id: int) -> None:
             try:
                 client = self._client(executor_id)
-                for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
-                    results[p] = client.infer_partition(dataset.iter_partition(p), qname_in, qname_out)
+                for p in range(worker_pos, dataset.num_partitions, num_workers):
+                    with cond:
+                        cond.wait_for(lambda: p < state["next"] + window
+                                      or state["stopped"])
+                        if state["stopped"]:
+                            return
+                    part = client.infer_partition(dataset.iter_partition(p),
+                                                  qname_in, qname_out)
+                    with cond:
+                        buf[p] = part
+                        cond.notify_all()
             except Exception as e:
-                errors.append(e)
+                with cond:
+                    errors.append(e)
+                    cond.notify_all()
+            finally:
+                with cond:
+                    state["done"] += 1
+                    cond.notify_all()
 
         threads = [
-            threading.Thread(target=_infer_worker, args=(pos, eid), name=f"infer-{eid}")
+            threading.Thread(target=_infer_worker, args=(pos, eid),
+                             name=f"infer-{eid}", daemon=True)
             for pos, eid in enumerate(self._feed_ids)
         ]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        try:
+            for p in range(dataset.num_partitions):
+                with cond:
+                    cond.wait_for(lambda: p in buf or errors
+                                  or state["done"] == num_workers)
+                    if errors:
+                        raise RuntimeError(f"inference failed: {errors[0]}") from errors[0]
+                    if p not in buf:
+                        # every worker exited without error yet p is missing
+                        self._raise_node_errors()
+                        raise RuntimeError(f"inference lost partition {p}")
+                    part = buf.pop(p)
+                    state["next"] = p + 1
+                    cond.notify_all()
+                yield p, part
+        finally:
+            with cond:
+                state["stopped"] = True
+                cond.notify_all()
+            for t in threads:
+                t.join()
         self._raise_node_errors()
-        if errors:
-            raise RuntimeError(f"inference failed: {errors[0]}") from errors[0]
-        return [item for part in results for item in (part or [])]
 
     # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
 
@@ -255,8 +317,10 @@ def run(
     queue_capacity: int = 1024,
     feed_timeout: float = 600.0,
     reservation_timeout: float = 120.0,
-    launcher: LocalLauncher | None = None,
+    heartbeat_interval: float = 2.0,
+    launcher: Any | None = None,
     env: dict[str, str] | None = None,
+    per_node_env: Sequence[dict[str, str]] | None = None,
     jax_distributed: bool = False,
 ) -> TPUCluster:
     """Start a cluster (reference ``TFCluster.run`` ``:~270-420``).
@@ -264,7 +328,14 @@ def run(
     No ``sc`` (no Spark), no ``num_ps`` (sync SPMD replaces parameter
     servers), no ``driver_ps_nodes``/``release_port`` (their race classes are
     designed out — SURVEY.md §5.2).
+
+    ``env`` applies to every node; ``per_node_env`` (one dict per executor)
+    layers per-process overrides on top — the carrier for disjoint
+    accelerator slices (``tpu_info.chip_visibility_env``) when several node
+    processes share a host.
     """
+    if per_node_env is not None and len(per_node_env) != num_executors:
+        raise ValueError(f"per_node_env needs {num_executors} entries, got {len(per_node_env)}")
     roles = _build_roles(num_executors, master_node, eval_node)
     coordinator = CoordinatorServer(num_executors, roles)
     addr = coordinator.start()
@@ -283,13 +354,14 @@ def run(
             queue_capacity=queue_capacity,
             feed_timeout=feed_timeout,
             reservation_timeout=reservation_timeout,
+            heartbeat_interval=heartbeat_interval,
             default_fs=default_fs,
             log_dir=log_dir,
             tensorboard=tensorboard,
             jax_distributed=jax_distributed,
-            env=dict(env or {}),
+            env={**(env or {}), **(per_node_env[i] if per_node_env else {})},
         )
-        for _ in range(num_executors)
+        for i in range(num_executors)
     ]
     launcher = launcher or LocalLauncher()
     launcher.launch(configs, log_dir or None)

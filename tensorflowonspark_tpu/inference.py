@@ -5,7 +5,9 @@ partitions through each node's queues into the user map_fun, which emitted
 exactly one result per input item via ``tf_feed.batch_results``.  The
 examples all hand-wrote that loop; here it ships as a framework map_fun
 driven by an exported bundle (config 5, Inception-v3 streaming inference,
-BASELINE.json:11).
+BASELINE.json:11).  ``TPUModel.transform`` (pipeline.py) rides the same
+loop for executor-side DataFrame scoring (reference ``pipeline._run_model``,
+``pipeline.py:~500-700``).
 
 TPU notes: the feed batch is padded to a static shape before the jitted
 apply (one compile, no tail recompiles) and unpadded before emission so the
@@ -23,12 +25,44 @@ def _arg(args, name, default=None):
     return getattr(args, name, default)
 
 
+def rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
+    """Stack mapped feature columns into one batch array.
+
+    Row dicts with a multi-column ``input_mapping`` are concatenated on the
+    trailing feature axis in mapping order (each column flattened to
+    ``[B, -1]`` first) — the single-array contract jitted apply fns expose.
+    A single mapped column keeps its natural shape (images stay ``[B,H,W,C]``).
+    Non-dict rows are stacked directly.
+    """
+    if isinstance(rows[0], dict):
+        if input_mapping:
+            cols = list(input_mapping)
+            missing = [c for c in cols if c not in rows[0]]
+            if missing:
+                raise KeyError(f"input_mapping columns {missing} not in row "
+                               f"(have {sorted(rows[0])})")
+        elif "features" in rows[0]:
+            cols = ["features"]
+        elif "image" in rows[0]:
+            cols = ["image"]
+        else:
+            raise ValueError(
+                f"cannot pick a feature column from {sorted(rows[0])}; set input_mapping"
+            )
+        arrays = [np.stack([np.asarray(r[c], np.float32) for r in rows]) for c in cols]
+        if len(arrays) == 1:
+            return arrays[0]
+        return np.concatenate([a.reshape(a.shape[0], -1) for a in arrays], axis=-1)
+    return np.stack([np.asarray(r, np.float32) for r in rows])
+
+
 def bundle_inference_loop(args, ctx) -> None:
     """map_fun: score the stream with the bundle at ``args.export_dir``.
 
     Emits one prediction (np.ndarray of logits/scores) per input item, in
     order.  Optional args: ``batch_size`` (default 64), ``postprocess``
-    ("argmax" to emit int class ids instead of logit vectors).
+    ("argmax" to emit int class ids instead of logit vectors),
+    ``input_mapping`` (row-dict column selection, see ``rows_to_features``).
     """
     from tensorflowonspark_tpu.checkpoint import load_bundle_cached
     from tensorflowonspark_tpu.models.registry import build_apply
@@ -38,6 +72,7 @@ def bundle_inference_loop(args, ctx) -> None:
         raise ValueError("bundle_inference_loop requires args.export_dir")
     batch_size = int(_arg(args, "batch_size", 64) or 64)
     postprocess = _arg(args, "postprocess")
+    input_mapping = _arg(args, "input_mapping")
 
     variables, config, apply_fn = load_bundle_cached(export_dir, build_apply)
     feed = ctx.get_data_feed(train_mode=False)
@@ -47,7 +82,7 @@ def bundle_inference_loop(args, ctx) -> None:
             continue
         n = len(items)
         padded = list(items) + [items[-1]] * (batch_size - n)
-        x = np.stack([np.asarray(i, np.float32) for i in padded])
+        x = rows_to_features(padded, input_mapping)
         preds = np.asarray(apply_fn(variables, x))[:n]
         if postprocess == "argmax":
             results = [int(p) for p in preds.argmax(axis=-1)]

@@ -5,19 +5,35 @@ In the reference, Spark places one long-running task per executor
 ``TFCluster.py:~340-360``) and YARN/Hops provisions the hosts.  Here a
 launcher backend owns process placement (SURVEY.md §7.1-4):
 
-- ``LocalLauncher`` — N node processes on this machine (the test/dev path,
-  mirroring the reference's ``local-cluster[N,...]`` test trick, SURVEY.md §4).
-- ``TPUPodLauncher`` — placement across TPU-VM hosts of a pod slice; each
-  host runs one node process that owns that host's chips.  Requires an
-  out-of-band transport (ssh/GKE); scaffolded, not implemented in-repo.
+- ``LocalLauncher`` — N node processes on this machine via multiprocessing
+  (the test/dev path, mirroring the reference's ``local-cluster[N,...]``
+  test trick, SURVEY.md §4).
+- ``SubprocessLauncher`` — N node processes as fresh OS subprocesses, each
+  with its own environment.  Required for per-process accelerator
+  visibility (``TPU_VISIBLE_CHIPS`` / ``JAX_NUM_CPU_DEVICES``) and for
+  ``jax.distributed`` runs, where env must be in place *before* the child
+  interpreter starts (site hooks may import jax at startup).
+- ``TPUPodLauncher`` — placement across the hosts of a TPU pod slice; one
+  node process per TPU-VM host, spawned over a pluggable transport
+  (default: ``ssh``; ``transport='local'`` runs every "host" on this
+  machine for single-box pods and tests).  Composes
+  ``tpu_info.chip_visibility_env`` + ``bounds_from_coords`` so each
+  process sees exactly its chip slice.
+
+All launchers expose the same surface consumed by ``cluster.TPUCluster``:
+``launch(configs, log_dir)``, ``processes`` (handles with ``.exitcode``),
+``join(timeout)``, ``alive()``, ``terminate()``.
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import shlex
+import subprocess
 import sys
-from typing import Sequence
+import time
+from typing import Callable, Sequence
 
 import cloudpickle
 
@@ -43,6 +59,11 @@ class LocalLauncher:
     initialized in the driver is unsafe, and spawn matches how real TPU-VM
     hosts start fresh Python processes.  ``map_fun`` travels via cloudpickle
     (the same closure-shipping contract Spark gave the reference).
+
+    Env caveat: ``config.env`` is applied inside ``node_main`` — after the
+    child interpreter (and any site hooks) started.  Vars that must be seen
+    at interpreter startup (``JAX_PLATFORMS`` under a sitecustomize that
+    imports jax, ``TPU_VISIBLE_CHIPS``) need ``SubprocessLauncher``.
     """
 
     def __init__(self, env: dict[str, str] | None = None):
@@ -66,8 +87,6 @@ class LocalLauncher:
 
     def join(self, timeout: float | None = None) -> bool:
         """Join all node processes; True if all exited within the timeout."""
-        import time
-
         deadline = None if timeout is None else time.monotonic() + timeout
         for p in self._procs:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
@@ -87,25 +106,258 @@ class LocalLauncher:
                 p.kill()
 
 
-class TPUPodLauncher:
-    """Placement across the hosts of a TPU pod slice (scaffold).
+class PopenHandle:
+    """Adapt ``subprocess.Popen`` to the ``mp.Process``-ish handle surface
+    (``exitcode``/``is_alive``/``join``/``terminate``/``kill``) that
+    ``TPUCluster.shutdown`` consumes."""
 
-    One node process per TPU-VM host; each process sees that host's chips and
-    joins the global mesh via ``jax.distributed`` (``NodeConfig.jax_distributed``).
-    Transport (ssh / GKE Jobset / queued resources) is deployment-specific and
-    injected as a ``spawn_fn(host, command) -> handle``.
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def exitcode(self) -> int | None:
+        return self.proc.poll()
+
+    def is_alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def join(self, timeout: float | None = None) -> None:
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def terminate(self) -> None:
+        if self.is_alive():
+            self.proc.terminate()
+
+    def kill(self) -> None:
+        if self.is_alive():
+            self.proc.kill()
+
+
+def _node_command() -> list[str]:
+    """The command line that runs one node from a stdin payload.
+
+    ``node_entry`` is a dedicated module NOT imported by the package
+    ``__init__`` — running ``-m`` on a module that is also imported as a
+    package attribute would execute it twice as two distinct module objects
+    (runpy's 'found in sys.modules' hazard)."""
+    return [sys.executable, "-m", "tensorflowonspark_tpu.node_entry"]
+
+
+def _pythonpath_env() -> dict[str, str]:
+    """PYTHONPATH that reproduces the driver's ``sys.path`` in a fresh local
+    interpreter, so cloudpickled map_funs resolve their defining modules
+    (and this package itself imports from a source checkout).  The same
+    contract Spark gave the reference by shipping the driver's PYTHONPATH /
+    egg to executors; ``multiprocessing`` spawn does it implicitly for
+    ``LocalLauncher``."""
+    entries = [p for p in sys.path if p and os.path.isdir(p)]
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if pkg_parent not in entries:
+        entries.append(pkg_parent)
+    return {"PYTHONPATH": os.pathsep.join(entries)}
+
+
+class SubprocessLauncher:
+    """Spawn node processes as fresh OS subprocesses with per-node env.
+
+    Each child runs ``python -m tensorflowonspark_tpu.launcher`` and reads
+    its cloudpickled ``NodeConfig`` from stdin.  ``config.env`` is merged
+    into the *OS-level* environment of the child, so interpreter-startup
+    consumers (PJRT plugins registered from sitecustomize, libtpu chip
+    visibility) see it — the property ``LocalLauncher`` cannot provide.
     """
 
-    def __init__(self, hosts: list[str], spawn_fn=None):
-        self.hosts = hosts
-        self.spawn_fn = spawn_fn
+    def __init__(self, env: dict[str, str] | None = None):
+        self.env = dict(env or {})
+        self._procs: list[PopenHandle] = []
 
-    def launch(self, configs, log_dir=None):  # pragma: no cover - needs a pod
-        if self.spawn_fn is None:
-            raise NotImplementedError(
-                "TPUPodLauncher needs a spawn_fn (ssh/GKE transport); "
-                "use LocalLauncher for single-host runs"
-            )
-        for host, config in zip(self.hosts, configs):
+    def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
+        for i, config in enumerate(configs):
+            config.env = {**self.env, **config.env}
+            child_env = {**os.environ, **_pythonpath_env(), **config.env}
+            if log_dir:
+                log_f = open(os.path.join(log_dir, f"node_{i}.log"), "ab", buffering=0)
+            else:
+                log_f = None
             payload = cloudpickle.dumps(config)
-            self.spawn_fn(host, payload)
+            proc = subprocess.Popen(
+                _node_command(),
+                stdin=subprocess.PIPE,
+                stdout=log_f if log_f else None,
+                stderr=subprocess.STDOUT if log_f else None,
+                env=child_env,
+            )
+            proc.stdin.write(payload)
+            proc.stdin.close()
+            if log_f is not None:
+                log_f.close()  # child holds its own fd now
+            self._procs.append(PopenHandle(proc))
+
+    @property
+    def processes(self) -> list[PopenHandle]:
+        return list(self._procs)
+
+    def join(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self._procs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            p.join(remaining)
+        return all(p.exitcode is not None for p in self._procs)
+
+    def alive(self) -> list[int]:
+        return [i for i, p in enumerate(self._procs) if p.is_alive()]
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            p.join(5.0)
+            if p.is_alive():
+                p.kill()
+
+
+class TPUPodLauncher:
+    """Placement across the hosts of a TPU pod slice.
+
+    One node process per TPU-VM host; each process sees that host's chips
+    (or an explicit slice of them) and joins the global mesh via
+    ``jax.distributed`` (``NodeConfig.jax_distributed=True`` is forced).
+
+    Transports:
+    - ``'ssh'`` (default): ``ssh <host> env K=V... python -m
+      tensorflowonspark_tpu.launcher`` with the pickled config streamed over
+      stdin.  Requires passwordless ssh and the package importable on the
+      remote host — the TPU-VM idiom (reference parity:
+      ``TFCluster.py:~340-360`` used Spark's executor placement instead).
+    - ``'local'``: every "host" is this machine; used for single-host
+      multi-process pods and for tests.
+    - a callable ``transport(host, command, env) -> subprocess.Popen`` for
+      custom fabrics (GKE exec, tpu-vm ssh wrappers, ...).
+
+    ``chip_slices`` optionally gives each host's chip ids (e.g. two
+    processes splitting one host's 4 chips: ``[[0, 1], [2, 3]]``); the env
+    is then derived via ``tpu_info.chip_visibility_env``, with process
+    bounds from ``tpu_info.bounds_from_coords`` when ``chip_coords`` (the
+    discovered per-chip mesh coordinates) is supplied.  Without slices,
+    each process sees everything its host exposes — the common whole-host
+    pod layout.
+    """
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        transport: str | Callable = "ssh",
+        env: dict[str, str] | None = None,
+        chip_slices: Sequence[Sequence[int]] | None = None,
+        chip_coords: Sequence[Sequence[Sequence[int]]] | None = None,
+        platform: str = "tpu",
+        simulate_chips: int | None = None,
+    ):
+        if chip_slices is not None and len(chip_slices) != len(hosts):
+            raise ValueError("chip_slices must have one entry per host")
+        self.hosts = list(hosts)
+        self.transport = transport
+        self.env = dict(env or {})
+        self.chip_slices = [list(s) for s in chip_slices] if chip_slices else None
+        self.chip_coords = chip_coords
+        self.platform = platform
+        self.simulate_chips = simulate_chips
+        self._procs: list[PopenHandle] = []
+
+    # -- env composition -----------------------------------------------------
+
+    def host_env(self, index: int) -> dict[str, str]:
+        """The accelerator-visibility env for host ``index``."""
+        from tensorflowonspark_tpu import tpu_info
+
+        env = dict(self.env)
+        if self.chip_slices is not None:
+            bounds = None
+            if self.chip_coords is not None:
+                bounds = tpu_info.bounds_from_coords(self.chip_coords[index])
+            env.update(tpu_info.chip_visibility_env(
+                self.chip_slices[index], platform=self.platform,
+                simulate_chips=self.simulate_chips, bounds=bounds))
+        elif self.platform == "cpu":
+            env.update(tpu_info.chip_visibility_env(
+                (), platform="cpu", simulate_chips=self.simulate_chips))
+        return env
+
+    # -- spawning ------------------------------------------------------------
+
+    def _spawn(self, host: str, env: dict[str, str], payload: bytes,
+               log_f) -> PopenHandle:
+        command = _node_command()
+        if callable(self.transport):
+            proc = self.transport(host, command, env)
+        elif self.transport == "local":
+            proc = subprocess.Popen(
+                command, stdin=subprocess.PIPE,
+                stdout=log_f if log_f else None,
+                stderr=subprocess.STDOUT if log_f else None,
+                env={**os.environ, **_pythonpath_env(), **env})
+        elif self.transport == "ssh":
+            # ssh joins argv into ONE remote shell line, so every env value
+            # and command token must be shell-quoted (XLA_FLAGS routinely
+            # holds spaces; unquoted values would also be an injection hole).
+            env_prefix = ["env"] + [
+                shlex.quote(f"{k}={v}") for k, v in sorted(env.items())]
+            remote = env_prefix + [shlex.quote(c) for c in command]
+            proc = subprocess.Popen(
+                ["ssh", "-o", "BatchMode=yes", host] + remote,
+                stdin=subprocess.PIPE,
+                stdout=log_f if log_f else None,
+                stderr=subprocess.STDOUT if log_f else None)
+        else:
+            raise ValueError(f"unknown transport {self.transport!r}")
+        proc.stdin.write(payload)
+        proc.stdin.close()
+        return PopenHandle(proc)
+
+    def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
+        if len(configs) != len(self.hosts):
+            raise ValueError(
+                f"pod launcher got {len(configs)} configs for {len(self.hosts)} hosts")
+        for i, (host, config) in enumerate(zip(self.hosts, configs)):
+            config.jax_distributed = True  # a pod IS a jax.distributed job
+            config.env = {**self.host_env(i), **config.env}
+            log_f = None
+            if log_dir:
+                log_f = open(os.path.join(log_dir, f"node_{i}.log"), "ab", buffering=0)
+            payload = cloudpickle.dumps(config)
+            try:
+                self._procs.append(self._spawn(host, config.env, payload, log_f))
+            finally:
+                if log_f is not None:
+                    log_f.close()
+
+    @property
+    def processes(self) -> list[PopenHandle]:
+        return list(self._procs)
+
+    def join(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self._procs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            p.join(remaining)
+        return all(p.exitcode is not None for p in self._procs)
+
+    def alive(self) -> list[int]:
+        return [i for i, p in enumerate(self._procs) if p.is_alive()]
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            p.join(5.0)
+            if p.is_alive():
+                p.kill()
+
+

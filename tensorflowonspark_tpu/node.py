@@ -157,6 +157,12 @@ class NodeContext:
         count = self.num_data_nodes if group == "data" else None
         self._client.barrier(f"{name}:{_next_barrier_id()}", self.executor_id, timeout, count=count)
 
+    def update_meta(self, patch: dict) -> None:
+        """Publish metadata to the driver's ``cluster_info`` view (the same
+        channel the TensorBoard URL uses) — e.g. device facts or results a
+        test/driver wants to observe after shutdown."""
+        self._client.update_meta(self.executor_id, patch)
+
 
 _barrier_counter = [0]
 
@@ -164,6 +170,30 @@ _barrier_counter = [0]
 def _next_barrier_id() -> int:
     _barrier_counter[0] += 1
     return _barrier_counter[0]
+
+
+def _apply_jax_env_config() -> None:
+    """Re-assert env-var JAX config onto ``jax.config``.
+
+    JAX reads ``JAX_PLATFORMS``/``JAX_NUM_CPU_DEVICES``/
+    ``JAX_CPU_COLLECTIVES_IMPLEMENTATION`` at import; but a site hook (e.g. a
+    vendor PJRT plugin registered from sitecustomize) may have imported jax at
+    interpreter startup and *overridden* the config before ``config.env`` was
+    applied — and under ``LocalLauncher`` the env itself lands only inside
+    ``node_main``.  Backends initialize lazily, so forcing the config here
+    (before any ``jax.devices()`` call) is still early enough.
+    """
+    import jax
+
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats and jax.config.jax_platforms != plats:
+        jax.config.update("jax_platforms", plats)
+    n = os.environ.get("JAX_NUM_CPU_DEVICES")
+    if n and jax.config.jax_num_cpu_devices != int(n):
+        jax.config.update("jax_num_cpu_devices", int(n))
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+    if impl and jax.config.jax_cpu_collectives_implementation != impl:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
 
 
 def _start_tensorboard(log_dir: str) -> tuple[subprocess.Popen | None, str | None]:
@@ -188,6 +218,7 @@ def node_main(config: NodeConfig) -> int:
     """Entry point of one node process; returns a process exit code."""
     for k, v in config.env.items():
         os.environ[k] = v
+    _apply_jax_env_config()
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [node %(process)d] %(name)s: %(message)s",

@@ -1,0 +1,33 @@
+"""Child-process entry: ``python -m tensorflowonspark_tpu.node_entry``.
+
+Runs one node whose cloudpickled ``NodeConfig`` arrives on stdin (the
+SubprocessLauncher / TPUPodLauncher spawn contract — the analogue of the
+reference's Spark-executor task entry, ``TFSparkNode.py:~200-260``).
+
+Deliberately a leaf module that the package ``__init__`` does NOT import:
+``-m`` on a module already imported as a package attribute executes its body
+twice as two distinct module objects (runpy's ``found in sys.modules``
+warning), which breaks class-identity checks in the child.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    payload = sys.stdin.buffer.read()
+    if not payload:
+        print("tensorflowonspark_tpu.node_entry: no NodeConfig on stdin",
+              file=sys.stderr)
+        return 2
+    import cloudpickle
+
+    config = cloudpickle.loads(payload)
+    from tensorflowonspark_tpu.node import node_main
+
+    return node_main(config)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,6 @@ import numpy as np
 
 from tensorflowonspark_tpu import cluster as _cluster
 from tensorflowonspark_tpu import dfutil
-from tensorflowonspark_tpu.checkpoint import load_bundle_cached
 from tensorflowonspark_tpu.cluster import InputMode
 from tensorflowonspark_tpu.data import PartitionedDataset, as_partitioned
 
@@ -299,26 +298,54 @@ class TPUModel(TPUParams):
         self.tf_args = tf_args
 
     def transform(self, dataset: Any) -> PartitionedDataset:
-        """Score rows partition-by-partition; preserves partition order/count.
+        """Score rows on a cluster of executors; preserves partition order/count.
 
-        Rows are dicts; ``input_mapping`` {column → model input} selects
-        feature columns (default: the single column "features" or "image");
+        Reference parity (``pipeline.py:~500-700``): ``TFModel._transform``
+        scored partitions on *executors* with a per-executor cached
+        SavedModel.  Here each of ``num_executors`` node processes runs
+        ``inference.bundle_inference_loop`` over its share of partitions with
+        a per-process cached bundle; the driver merges predictions back into
+        the rows.  Rows are dicts; ``input_mapping`` {column → model input}
+        selects feature columns (multi-column mappings are concatenated on
+        the feature axis, see ``inference.rows_to_features``);
         ``output_mapping`` {model output → column} names prediction columns
         (default: {"prediction": "prediction"}).
         """
+        from tensorflowonspark_tpu.inference import bundle_inference_loop
+
         args = self.merge_args_params(self.tf_args)
         export_dir = args.get("export_dir")
         if not export_dir:
             raise ValueError("TPUModel requires export_dir")
-        data = as_partitioned(dataset, default_partitions=1)
-        batch_size = int(args.get("batch_size") or 64)
-        input_mapping = args.get("input_mapping")
+        num_executors = max(1, int(args.get("num_executors") or 1))
+        data = as_partitioned(dataset, default_partitions=num_executors)
         output_mapping = args.get("output_mapping") or {"prediction": "prediction"}
-        parts = [
-            _score_partition(list(data.iter_partition(p)), export_dir,
-                             batch_size, input_mapping, output_mapping)
-            for p in range(data.num_partitions)
-        ]
+        cluster = _cluster.run(
+            bundle_inference_loop,
+            args,
+            num_executors=num_executors,
+            input_mode=InputMode.STREAMING,
+            feed_timeout=args.feed_timeout,
+            reservation_timeout=args.reservation_timeout,
+        )
+        try:
+            pred_parts = cluster.inference(data, flat=False)
+        finally:
+            cluster.shutdown()
+        parts = []
+        for p, preds in enumerate(pred_parts):
+            rows = list(data.iter_partition(p))
+            if len(preds) != len(rows):
+                raise RuntimeError(
+                    f"partition {p}: {len(preds)} predictions for {len(rows)} rows "
+                    "(exactly-count invariant violated)")
+            out = []
+            for row, pred in zip(rows, preds):
+                row_out = dict(row) if isinstance(row, dict) else {}
+                for _, col in output_mapping.items():
+                    row_out[col] = np.asarray(pred)
+                out.append(row_out)
+            parts.append(out)
         return PartitionedDataset.from_partitions(parts)
 
 
@@ -329,44 +356,3 @@ def _is_row_data(data: PartitionedDataset) -> bool:
     return False
 
 
-def _score_partition(rows: list, export_dir: str, batch_size: int,
-                     input_mapping: dict | None, output_mapping: dict) -> list:
-    """Exactly-count, order-preserving scoring of one partition
-    (SURVEY.md §3.3 invariant).  Pads the tail batch for one static jit
-    shape, then unpads — no recompiles per partition tail."""
-    from tensorflowonspark_tpu.models.registry import build_apply
-
-    if not rows:
-        return []
-    params, config, apply_fn = load_bundle_cached(export_dir, build_apply)
-    out: list = []
-    for start in range(0, len(rows), batch_size):
-        chunk = rows[start : start + batch_size]
-        n = len(chunk)
-        padded = chunk + [chunk[-1]] * (batch_size - n)
-        features = _rows_to_features(padded, input_mapping)
-        preds = apply_fn(params, features)
-        preds = np.asarray(preds)[:n]
-        for i in range(n):
-            row_out = dict(chunk[i]) if isinstance(chunk[i], dict) else {}
-            for _, col in output_mapping.items():
-                row_out[col] = preds[i]
-            out.append(row_out)
-    return out
-
-
-def _rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
-    """Stack the mapped feature column into one batch array."""
-    if isinstance(rows[0], dict):
-        if input_mapping:
-            col = next(iter(input_mapping))
-        elif "features" in rows[0]:
-            col = "features"
-        elif "image" in rows[0]:
-            col = "image"
-        else:
-            raise ValueError(
-                f"cannot pick a feature column from {sorted(rows[0])}; set input_mapping"
-            )
-        return np.stack([np.asarray(r[col], np.float32) for r in rows])
-    return np.stack([np.asarray(r, np.float32) for r in rows])

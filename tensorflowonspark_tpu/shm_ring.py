@@ -130,6 +130,8 @@ class ShmRing:
         return _lib().tos_ring_capacity(self._h)
 
     def _push_record(self, record: bytes, timeout: float | None) -> None:
+        if not self._h:
+            raise RingClosed("ring detached")
         rc = _lib().tos_ring_push(self._h, record, len(record),
                                   -1 if timeout is None else int(timeout * 1000))
         if rc == 1:
@@ -152,6 +154,8 @@ class ShmRing:
                               timeout)
 
     def _pop_record(self, timeout: float | None) -> bytes:
+        if not self._h:
+            raise RingClosed("ring detached")
         lib = _lib()
         tmo = -1 if timeout is None else int(timeout * 1000)
         size = lib.tos_ring_next_size(self._h, tmo)

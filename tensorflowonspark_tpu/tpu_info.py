@@ -124,7 +124,15 @@ def chip_visibility_env(chip_ids: Sequence[int], *, platform: str = "tpu",
         n = simulate_chips if simulate_chips is not None else len(chip_ids)
         return {
             "JAX_PLATFORMS": "cpu",
+            # Both spellings: JAX_NUM_CPU_DEVICES is the authoritative config
+            # knob (survives plugins that rewrite XLA_FLAGS); the flag form
+            # covers older JAX versions that only read XLA_FLAGS.
+            "JAX_NUM_CPU_DEVICES": str(max(1, n)),
             "XLA_FLAGS": f"--xla_force_host_platform_device_count={max(1, n)}",
+            # Cross-process CPU collectives (the ICI/DCN simulation for
+            # multi-process jax.distributed runs): gloo is the only portable
+            # in-tree implementation.  Harmless for single-process use.
+            "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
         }
     ids = ",".join(str(int(c)) for c in chip_ids)
     n = len(chip_ids)

@@ -22,6 +22,9 @@ This environment force-registers an exclusive single-TPU PJRT plugin from
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Preserve the original plugin key so `-m tpu` tests can spawn subprocesses
+# with the real-chip env restored (tests/test_tpu_smoke.py).
+os.environ.setdefault("TPU_SMOKE_POOL_IPS", os.environ.get("PALLAS_AXON_POOL_IPS", ""))
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -1,0 +1,17 @@
+"""Child process hosting a bare DataServer; liveness tests SIGKILL it
+mid-call to exercise the driver-side data-plane failure semantics."""
+
+import sys
+import time
+
+from tensorflowonspark_tpu.dataserver import DataServer
+from tensorflowonspark_tpu.feeding import FeedQueues
+
+if __name__ == "__main__":
+    authkey = bytes.fromhex(sys.argv[1])
+    queues = FeedQueues(("input", "output", "error"), capacity=1024)
+    server = DataServer(queues, authkey, feed_timeout=600.0)
+    port = server.start()
+    print(port, flush=True)
+    while True:
+        time.sleep(1)

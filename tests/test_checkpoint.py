@@ -41,6 +41,82 @@ def test_hdfs_scheme(tmp_path):
     assert step == 3
 
 
+def test_async_save_commits_and_restores(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "a"), async_save=True)
+    mgr.save(7, tree())
+    # restore_latest must first wait for the in-flight commit
+    restored, step = mgr.restore_latest()
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree()["w"]))
+
+
+def test_async_manager_keeps_newest(tmp_path):
+    """The keep-K window must account for the in-flight async save."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "m"), max_to_keep=2, async_save=True)
+    for s in [1, 5, 9]:
+        mgr.save(s, {"s": jnp.asarray(s)})
+    mgr.wait()
+    import os
+
+    kept = sorted(os.listdir(tmp_path / "m"))
+    assert kept == ["step_5", "step_9"], kept
+
+
+def test_full_state_resume_matches_uninterrupted(tmp_path):
+    """Kill-and-restart semantics (VERDICT r2 item 7): a restart from a
+    full-train-state checkpoint (params + opt_state + step) must continue the
+    EXACT loss trajectory of an uninterrupted run — momentum survives.  A
+    params-only restore demonstrably does not."""
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    def fresh_state():
+        params = {"w": jnp.ones((4, 1), jnp.float32)}
+        return dplib.TrainState.create(params, optax.sgd(0.1, momentum=0.9))
+
+    rng = np.random.RandomState(0)
+    batches = [{"x": jnp.asarray(rng.rand(8, 4), jnp.float32),
+                "y": jnp.asarray(rng.rand(8, 1), jnp.float32)} for _ in range(10)]
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    step_fn = dplib.make_train_step(loss_fn, optimizer, donate=False)
+
+    def run(state, bs):
+        losses = []
+        for b in bs:
+            state, m = step_fn(state, b)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    # A: uninterrupted 10 steps
+    _, losses_a = run(fresh_state(), batches)
+
+    # B: 5 steps, full-state save, "process death", restore, 5 more
+    mgr = ckpt.CheckpointManager(str(tmp_path / "resume"))
+    state_b, _ = run(fresh_state(), batches[:5])
+    mgr.save(int(jax.device_get(state_b.step)), jax.device_get(state_b)._asdict())
+    mgr.wait()
+    del state_b
+    target = jax.device_get(fresh_state())._asdict()
+    restored_tree, step = ckpt.CheckpointManager(str(tmp_path / "resume")).restore_latest(target)
+    assert step == 5
+    resumed = dplib.TrainState(**restored_tree)
+    assert int(jax.device_get(resumed.step)) == 5
+    _, losses_b = run(resumed, batches[5:])
+    np.testing.assert_allclose(losses_b, losses_a[5:], rtol=1e-5)
+
+    # params-only restore loses momentum: trajectory must measurably diverge
+    partial = fresh_state()._replace(params=resumed.params)
+    _, losses_c = run(partial, batches[5:])
+    assert not np.allclose(losses_c, losses_a[5:], rtol=1e-5)
+
+
 def test_bundle_roundtrip(tmp_path):
     config = {"model": "mnist_cnn", "num_classes": 10, "features": [4, 8], "dense": 16}
     from tensorflowonspark_tpu.models import mnist

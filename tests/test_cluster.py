@@ -69,6 +69,39 @@ def test_inference_ordered_exact(tmp_path):
     assert results == [x * 2 for x in range(57)]  # ordered, exactly-count
 
 
+def test_inference_stream_lazy_and_bounded():
+    """inference_stream restores the lazy-RDD property (VERDICT r2 item 8):
+    partitions are read and yielded incrementally; with a small window the
+    workers must NOT run ahead of the consumer, bounding driver memory."""
+    reads: list[int] = []
+
+    def part_fn(p):
+        def gen():
+            reads.append(p)
+            yield from range(p * 10, p * 10 + 10)
+        return gen
+
+    data = tos.PartitionedDataset([part_fn(p) for p in range(10)])
+    cluster = tos.run(
+        mapfuns.echo_inference, {}, num_executors=2,
+        input_mode=InputMode.STREAMING, reservation_timeout=60,
+    )
+    try:
+        stream = cluster.inference_stream(data, window=2)
+        p0, res0 = next(stream)
+        assert p0 == 0 and res0 == [x * 2 for x in range(10)]
+        # window=2 + 2 workers: at most window + workers partitions may have
+        # been READ from the dataset before the consumer advanced
+        assert len(reads) <= 4, f"unbounded read-ahead: {reads}"
+        rest = list(stream)
+    finally:
+        cluster.shutdown()
+    assert [p for p, _ in rest] == list(range(1, 10))
+    assert all(res == [x * 2 for x in range(p * 10, p * 10 + 10)]
+               for p, res in rest)
+    assert sorted(reads) == list(range(10))  # every partition read exactly once
+
+
 def test_error_propagation():
     cluster = tos.run(mapfuns.failing, num_executors=2, reservation_timeout=60)
     with pytest.raises(RuntimeError, match="intentional failure"):

@@ -15,7 +15,8 @@ def start_pair(feed_timeout=5.0, capacity=1024):
     queues = FeedQueues(capacity=capacity)
     server = DataServer(queues, AUTH, feed_timeout=feed_timeout)
     port = server.start()
-    client = DataClient("127.0.0.1", port, AUTH, chunk_size=8)
+    client = DataClient("127.0.0.1", port, AUTH, chunk_size=8,
+                        stall_timeout=feed_timeout)
     return queues, server, client
 
 

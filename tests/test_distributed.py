@@ -1,0 +1,70 @@
+"""Multi-host (multi-process) jax.distributed integration tests.
+
+The CPU analogue of the reference's ``local-cluster[2,1,1024]`` in-process
+cluster tests (SURVEY.md §4): two real node processes, each seeing its own
+virtual CPU "chips", bootstrap one ``jax.distributed`` job through the
+coordinator's port-reduce (``node.py``), and run a cross-process collective.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tensorflowonspark_tpu import cluster as tcluster
+from tensorflowonspark_tpu import tpu_info
+from tensorflowonspark_tpu.launcher import SubprocessLauncher
+
+
+def _dist_map_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    info = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+    # Cross-process data-parallel reduction: each process contributes its own
+    # host-local shard; the jitted sum is an all-reduce over gloo (the DCN
+    # stand-in for XLA's ICI collectives on real pods).
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    x = jnp.ones((info["local_devices"],), jnp.float32) * (jax.process_index() + 1)
+    arr = multihost_utils.host_local_array_to_global_array(x, mesh, P("dp"))
+    total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(arr)
+    info["global_sum"] = float(total)
+    ctx.update_meta({"dist_check": info})
+    ctx.barrier("dist-done", timeout=120.0)
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed_psum(tmp_path):
+    env = tpu_info.chip_visibility_env((), platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        _dist_map_fun,
+        None,
+        num_executors=2,
+        input_mode=tcluster.InputMode.DIRECT,
+        launcher=SubprocessLauncher(),
+        env=env,
+        jax_distributed=True,
+        log_dir=str(tmp_path),
+        reservation_timeout=180.0,
+    )
+    cluster.shutdown(timeout=300.0)
+    infos = [m.get("dist_check") for m in cluster.coordinator.cluster_info()]
+    assert all(i is not None for i in infos), f"missing dist_check: {infos}"
+    for info in infos:
+        assert info["process_count"] == 2
+        assert info["local_devices"] == 2
+        # global view = union of both processes' devices
+        assert info["global_devices"] == 4
+        # host0 contributes [1,1], host1 [2,2] -> 6
+        assert info["global_sum"] == 6.0
+    # the post-initialize device report replaced the placeholder
+    for m in cluster.coordinator.cluster_info():
+        assert m["device"]["platform"] == "cpu"
+        assert m["device"]["num_devices"] == 2

@@ -1,0 +1,128 @@
+"""Launcher unit tests: per-host env composition and transport plumbing.
+
+The heavyweight end-to-end (two real node processes joining one
+jax.distributed job) lives in test_distributed.py; these tests pin the
+cheap invariants: chip-slice env derivation (the CUDA_VISIBLE_DEVICES
+analogue), ssh command construction, and payload delivery over stdin.
+"""
+
+from __future__ import annotations
+
+import io
+
+import cloudpickle
+
+from tensorflowonspark_tpu import launcher as launchermod
+from tensorflowonspark_tpu.launcher import SubprocessLauncher, TPUPodLauncher
+from tensorflowonspark_tpu.node import NodeConfig
+
+
+def _config(**kw) -> NodeConfig:
+    return NodeConfig(coordinator_addr=("127.0.0.1", 1), authkey=b"k",
+                      map_fun=lambda a, c: None, **kw)
+
+
+class _CapturingStdin(io.BytesIO):
+    def close(self):
+        self.value = self.getvalue()
+        super().close()
+
+
+class _FakeProc:
+    def __init__(self):
+        self.stdin = _CapturingStdin()
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+
+def test_pod_launcher_chip_slice_env():
+    pod = TPUPodLauncher(
+        hosts=["host-a", "host-b"],
+        chip_slices=[[0, 1], [2, 3]],
+        chip_coords=[[[0, 0, 0], [1, 0, 0]], [[0, 1, 0], [1, 1, 0]]],
+    )
+    env0, env1 = pod.host_env(0), pod.host_env(1)
+    assert env0["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env1["TPU_VISIBLE_CHIPS"] == "2,3"
+    # bounds derived from the discovered coords, not guessed
+    assert env0["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+    assert env1["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+
+
+def test_pod_launcher_cpu_simulation_env():
+    pod = TPUPodLauncher(hosts=["localhost"], transport="local",
+                         platform="cpu", simulate_chips=4)
+    env = pod.host_env(0)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["JAX_NUM_CPU_DEVICES"] == "4"
+    assert env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "gloo"
+
+
+def test_pod_launcher_custom_transport_delivers_payload():
+    spawned = []
+
+    def transport(host, command, env):
+        proc = _FakeProc()
+        spawned.append((host, command, env, proc))
+        return proc
+
+    pod = TPUPodLauncher(hosts=["h0", "h1"], transport=transport,
+                         env={"MY_FLAG": "1"})
+    configs = [_config(), _config()]
+    pod.launch(configs)
+    assert [s[0] for s in spawned] == ["h0", "h1"]
+    for (host, command, env, proc), config in zip(spawned, configs):
+        assert command[-2:] == ["-m", "tensorflowonspark_tpu.node_entry"]
+        # pod membership forces the jax.distributed bootstrap
+        got = cloudpickle.loads(proc.stdin.value)
+        assert got.jax_distributed is True
+        assert got.env["MY_FLAG"] == "1"
+    assert pod.alive() == [0, 1]
+
+
+def test_pod_launcher_ssh_command(monkeypatch):
+    calls = []
+
+    def fake_popen(cmd, **kw):
+        calls.append(cmd)
+        return _FakeProc()
+
+    monkeypatch.setattr(launchermod.subprocess, "Popen", fake_popen)
+    pod = TPUPodLauncher(hosts=["tpu-vm-0"],
+                         env={"A": "1", "XLA_FLAGS": "--flag_a --flag_b"})
+    pod.launch([_config()])
+    (cmd,) = calls
+    assert cmd[0] == "ssh"
+    assert "tpu-vm-0" in cmd
+    env_i = cmd.index("env")
+    assert "A=1" in cmd[env_i:]
+    # ssh flattens argv into one remote shell line: values with spaces must
+    # arrive shell-quoted or `env` would execute '--flag_b' as the command
+    assert "'XLA_FLAGS=--flag_a --flag_b'" in cmd[env_i:]
+    assert cmd[-1].endswith("tensorflowonspark_tpu.node_entry")
+
+
+def test_pod_launcher_rejects_mismatched_configs():
+    pod = TPUPodLauncher(hosts=["a"])
+    try:
+        pod.launch([_config(), _config()])
+    except ValueError as e:
+        assert "2 configs" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_subprocess_launcher_handle_lifecycle():
+    import subprocess
+    import sys
+
+    launcher = SubprocessLauncher()
+    # bypass launch(): exercise the handle adapter directly on a real process
+    proc = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    handle = launchermod.PopenHandle(proc)
+    launcher._procs.append(handle)
+    assert launcher.join(timeout=30.0)
+    assert handle.exitcode == 3
+    assert launcher.alive() == []

@@ -1,0 +1,140 @@
+"""Failure-detection liveness tests (SURVEY.md §5.3; VERDICT r2 item 3).
+
+The round-2 liveness code paths under test:
+- coordinator loss mid-feed → heartbeat failures force EndOfFeed and the
+  node process exits on its own (``node.py`` heartbeat loop +
+  ``feeding.DataFeed`` stop_event polling);
+- node SIGKILL mid-ring-call → ``DataClient._call`` surfaces "ring reply
+  lost" within ``call_timeout`` and downgrades future calls to TCP
+  (``dataserver.py`` ring hazard semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import tensorflowonspark_tpu as tos
+from tensorflowonspark_tpu.cluster import InputMode
+from tensorflowonspark_tpu.dataserver import DataClient
+
+import mapfuns
+
+
+def test_coordinator_death_unblocks_node(tmp_path):
+    """Driver dies mid-feed (no EOF ever sent): the node must notice via
+    heartbeat failures and exit within ~3 heartbeat intervals instead of
+    wedging on the empty feed (reference feed_timeout semantics,
+    ``TFSparkNode.py:~460-490``)."""
+    cluster = tos.run(
+        mapfuns.sum_batches,
+        {"out_dir": str(tmp_path), "batch_size": 4},
+        num_executors=1,
+        input_mode=InputMode.STREAMING,
+        reservation_timeout=60,
+        heartbeat_interval=0.3,
+    )
+    client = cluster._client(0)
+    client.feed_partition(range(10))  # node consumed a partition, now blocked
+    t0 = time.monotonic()
+    cluster.coordinator.stop()  # the "driver crash": no EOF, no stop signal
+    # 3 failed heartbeats at 0.3s spacing plus connect/teardown slack
+    assert cluster.launcher.join(timeout=20.0), (
+        "node did not exit after coordinator loss"
+    )
+    elapsed = time.monotonic() - t0
+    assert [p.exitcode for p in cluster.launcher.processes] == [0]
+    # the forced EndOfFeed let map_fun finish cleanly: its output exists
+    assert (tmp_path / "node_0.txt").read_text().split()[1] == "10"
+    assert elapsed < 20.0
+    for c in cluster._clients.values():
+        c.close()
+
+
+def _spawn_dataserver_child(authkey: bytes) -> tuple[subprocess.Popen, int]:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "dataserver_child.py"),
+         authkey.hex()],
+        stdout=subprocess.PIPE, text=True, env=env)
+    port = int(child.stdout.readline())
+    return child, port
+
+
+def test_node_sigkill_mid_ring_call_raises_and_downgrades():
+    """SIGKILL the node process while a ring request is in flight: the ring's
+    closed flag is never set, so the client must time out, surface 'ring
+    reply lost', and route any later call over TCP."""
+    from tensorflowonspark_tpu import shm_ring
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring unavailable")
+    authkey = secrets.token_bytes(16)
+    child, port = _spawn_dataserver_child(authkey)
+    try:
+        client = DataClient("127.0.0.1", port, authkey, call_timeout=4.0)
+        if not client.using_ring:
+            pytest.skip("ring setup did not engage")
+        errors: list[BaseException] = []
+
+        def _call():
+            try:
+                # no consumer drains the output queue, so the reply never
+                # arrives; the child is killed while this waits
+                client.infer_partition([1, 2, 3])
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=_call)
+        t0 = time.monotonic()
+        t.start()
+        time.sleep(0.5)  # let the request land in the ring
+        os.kill(child.pid, signal.SIGKILL)
+        t.join(timeout=15.0)
+        assert not t.is_alive(), "ring call did not return within call_timeout"
+        assert time.monotonic() - t0 < 10.0
+        assert errors and "ring reply lost" in str(errors[0]), errors
+        # the failed ring is gone; the client is back on TCP
+        assert client.using_ring is False
+        # ...and a TCP call to the dead server fails promptly instead of
+        # hanging (no infinite wedge behind the dead ring)
+        with pytest.raises((RuntimeError, ConnectionError, OSError)):
+            client.send_eof("input")
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.wait(10)
+
+
+def test_ring_send_failure_downgrades_to_tcp():
+    """If the SEND side of the ring fails (server never saw the request) the
+    client retries the same call over TCP transparently."""
+    from tensorflowonspark_tpu import shm_ring
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring unavailable")
+    authkey = secrets.token_bytes(16)
+    child, port = _spawn_dataserver_child(authkey)
+    try:
+        client = DataClient("127.0.0.1", port, authkey, call_timeout=4.0)
+        if not client.using_ring:
+            pytest.skip("ring setup did not engage")
+        # sabotage the send ring only: closing our write side makes the next
+        # put raise RingClosed (send failed ⇒ server never saw the request)
+        client._c2s.close_write()
+        client.send_eof("input")  # must succeed via the TCP fallback
+        assert client.using_ring is False
+        client.close()
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.wait(10)

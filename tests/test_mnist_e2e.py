@@ -22,7 +22,6 @@ import mnist_tfr  # noqa: E402
 TINY = {"features": [4, 8], "dense": 16, "batch_size": 16, "lr": 0.05}
 
 
-@pytest.mark.slow
 def test_streaming_train_then_inference(tmp_path):
     from tensorflowonspark_tpu.models.mnist import synthetic_mnist
 
@@ -61,7 +60,35 @@ def test_streaming_train_then_inference(tmp_path):
     assert acc > 0.5, f"accuracy {acc}"
 
 
-@pytest.mark.slow
+def test_restart_resumes_from_checkpoint(tmp_path):
+    """Whole-job restart (SURVEY.md §5.3 recovery contract): a second cluster
+    pointed at the same model_dir must resume from the saved FULL train state
+    — the step counter keeps counting instead of resetting to zero."""
+    from tensorflowonspark_tpu.checkpoint import latest_step_dir
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    args = {**TINY, "model_dir": str(tmp_path / "model")}
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(96), 2)
+
+    c1 = tos.run(mnist_dist.main_fun, args, num_executors=1,
+                 input_mode=tos.InputMode.STREAMING,
+                 log_dir=str(tmp_path / "logs1"), reservation_timeout=120)
+    c1.train(data)
+    c1.shutdown(timeout=300)
+    first = latest_step_dir(str(tmp_path / "model"))
+    step1 = int(first.rsplit("_", 1)[1])
+    assert step1 > 0
+
+    # "restart": a brand-new cluster over the same model_dir
+    c2 = tos.run(mnist_dist.main_fun, args, num_executors=1,
+                 input_mode=tos.InputMode.STREAMING,
+                 log_dir=str(tmp_path / "logs2"), reservation_timeout=120)
+    c2.train(data)
+    c2.shutdown(timeout=300)
+    step2 = int(latest_step_dir(str(tmp_path / "model")).rsplit("_", 1)[1])
+    assert step2 == 2 * step1, (step1, step2)  # resumed, not restarted
+
+
 def test_direct_tfrecord_train(tmp_path):
     data_dir = str(tmp_path / "tfr")
     mnist_tfr.prepare_data(data_dir, samples=320, partitions=4)

@@ -48,6 +48,29 @@ def test_chip_visibility_env_cpu_simulation():
     env = tpu_info.chip_visibility_env([], platform="cpu", simulate_chips=8)
     assert env["JAX_PLATFORMS"] == "cpu"
     assert "device_count=8" in env["XLA_FLAGS"]
+    assert env["JAX_NUM_CPU_DEVICES"] == "8"
+    assert env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "gloo"
+
+
+def test_bounds_from_coords_dense_box():
+    # 2x2x1 host block (v2/v3 host layout)
+    coords = [[0, 0, 0], [1, 0, 0], [0, 1, 0], [1, 1, 0]]
+    assert tpu_info.bounds_from_coords(coords) == "2,2,1"
+    # offset boxes are still dense
+    coords = [[2, 4, 0], [3, 4, 0]]
+    assert tpu_info.bounds_from_coords(coords) == "2,1,1"
+    assert tpu_info.bounds_from_coords([[5, 7, 1]]) == "1,1,1"
+
+
+def test_bounds_from_coords_holes_and_dupes_are_none():
+    # hole: 3 chips spanning a 2x2 box
+    assert tpu_info.bounds_from_coords([[0, 0, 0], [1, 0, 0], [1, 1, 0]]) is None
+    # duplicate coordinate
+    assert tpu_info.bounds_from_coords([[0, 0, 0], [0, 0, 0]]) is None
+    # malformed: 2-d coords
+    assert tpu_info.bounds_from_coords([[0, 0], [1, 0]]) is None
+    # empty
+    assert tpu_info.bounds_from_coords([]) is None
 
 
 def test_profiler_trace_writes_tensorboard_profile(tmp_path):

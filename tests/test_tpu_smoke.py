@@ -1,0 +1,143 @@
+"""Real-TPU smoke suite (`pytest -m tpu`) — hardware evidence for the
+kernel/compute investments that the CPU-forced default gate cannot provide
+(VERDICT r2 item 4).
+
+The session conftest pins every test process (and its children) to the CPU
+platform, so each check here runs in a fresh subprocess with the real-chip
+env restored (``TPU_SMOKE_POOL_IPS`` snapshots the plugin key before the
+conftest clears it).  Checks:
+
+- the Pallas flash-attention kernel COMPILES on silicon and matches the
+  dense reference (the kernel had only ever run in interpret mode);
+- a bf16 transformer train step produces a finite loss on the chip;
+- ``shard_batch`` lands a host batch on the device mesh (the infeed path).
+
+Each subprocess pays backend init (~20-40s first compile), so everything
+shares ONE subprocess whose stdout carries per-check markers; tests assert
+their own marker.  Skips cleanly when the chip is unreachable (the
+``bench.py`` probe contract).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_TIMEOUT_S = 900
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SMOKE_SRC = r"""
+import jax, jax.numpy as jnp, numpy as np, optax
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+print("SMOKE devices", len(jax.devices()), flush=True)
+
+# -- 1. Pallas flash attention: compiled-on-TPU vs dense reference ----------
+from tensorflowonspark_tpu.ops import attention as att
+
+rng = np.random.RandomState(0)
+b, s, h, d = 2, 512, 4, 64
+q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+out = jax.jit(lambda q, k, v: att.flash_attention(
+    q, k, v, causal=True, impl="pallas", block_q=256, block_k=256))(q, k, v)
+ref = att.mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), causal=True)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+assert err < 0.08, f"pallas-vs-reference max err {err}"  # bf16 tolerance
+# offset composition (the ring-attention contract) on silicon too: a
+# fully-past KV chunk (kv_offset=-s) is entirely visible under causal
+out_off = jax.jit(lambda q, k, v: att.flash_attention(
+    q, k, v, causal=True, impl="pallas", kv_offset=-s,
+    block_q=256, block_k=256))(q, k, v)
+ref_off = att.mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                            v.astype(jnp.float32), causal=True, kv_offset=-s)
+err_off = float(jnp.max(jnp.abs(out_off.astype(jnp.float32) - ref_off)))
+assert err_off < 0.08, f"pallas kv_offset max err {err_off}"
+print(f"SMOKE_OK flash_attention err={err:.4f} err_off={err_off:.4f}", flush=True)
+
+# -- 2. bf16 transformer train step finite ----------------------------------
+from tensorflowonspark_tpu.models import transformer as tfm
+from tensorflowonspark_tpu.parallel import dp as dplib
+from tensorflowonspark_tpu.parallel import mesh as meshlib
+
+mesh = meshlib.make_mesh(dp=-1)
+model = tfm.build_transformer({"vocab_size": 512, "d_model": 256,
+                               "n_layers": 2, "n_heads": 4, "bf16": True})
+ids = jnp.asarray(rng.randint(0, 512, (4, 128)), jnp.int32)
+params = model.init(jax.random.PRNGKey(0), ids)["params"]
+optimizer = optax.adamw(1e-3)
+state = dplib.TrainState.create(dplib.replicate(params, mesh), optimizer)
+step = dplib.make_train_step(tfm.make_loss_fn(model), optimizer)
+batch = meshlib.shard_batch(mesh, {"input_ids": np.asarray(ids)})
+state, metrics = step(state, batch)
+state, metrics = step(state, batch)
+loss = float(jax.device_get(metrics["loss"]))
+assert np.isfinite(loss), loss
+print(f"SMOKE_OK transformer_bf16_step loss={loss:.4f}", flush=True)
+
+# -- 3. shard_batch infeed: host batch -> device mesh -----------------------
+x = {"image": rng.rand(32, 16, 16, 3).astype(np.float32),
+     "label": np.arange(32, dtype=np.int32)}
+dev = meshlib.shard_batch(mesh, x)
+assert dev["image"].sharding.is_fully_addressable
+np.testing.assert_array_equal(np.asarray(dev["label"]), x["label"])
+assert {d.platform for d in dev["image"].devices()} == {"tpu"}
+print("SMOKE_OK shard_batch_infeed", flush=True)
+"""
+
+
+def _tpu_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = env.get("TPU_SMOKE_POOL_IPS", "")
+    # drop the virtual-device CPU flag the conftest injected
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+_RESULT: dict = {}
+
+
+def _run_smoke() -> tuple[int, str]:
+    """Run the shared smoke subprocess once per session."""
+    if "out" not in _RESULT:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SMOKE_SRC], env=_tpu_env(),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                timeout=_TIMEOUT_S, cwd=_REPO)
+            _RESULT["rc"], _RESULT["out"] = proc.returncode, proc.stdout
+        except subprocess.TimeoutExpired as e:
+            _RESULT["rc"] = -1
+            _RESULT["out"] = f"TIMEOUT after {_TIMEOUT_S}s\n{e.stdout or ''}"
+    return _RESULT["rc"], _RESULT["out"]
+
+
+def _check(marker: str) -> None:
+    rc, out = _run_smoke()
+    if "SMOKE devices" not in out:
+        pytest.skip(f"TPU backend unreachable: {out.strip()[-400:]}")
+    assert f"SMOKE_OK {marker}" in out, f"rc={rc}\n{out[-4000:]}"
+
+
+def test_flash_attention_compiles_on_tpu():
+    _check("flash_attention")
+
+
+def test_transformer_bf16_step_on_tpu():
+    _check("transformer_bf16_step")
+
+
+def test_shard_batch_infeed_on_tpu():
+    _check("shard_batch_infeed")
