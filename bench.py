@@ -36,23 +36,19 @@ _PROBE_SRC = (
 )
 
 
-def bench_resnet50(batch_size: int = 256, image_size: int = 224,
-                   warmup: int = 3, steps: int = 20) -> dict:
+def _make_bench_state(mesh, image_size: int):
+    """Shared ResNet-50 bench setup: (state, step_fn), identical for the
+    synthetic and TFRecord-fed variants so their ratio compares one model."""
     import jax
-    import numpy as np
     import optax
 
     from tensorflowonspark_tpu.models import resnet
     from tensorflowonspark_tpu.parallel import dp as dplib
     from tensorflowonspark_tpu.parallel import mesh as meshlib
 
-    mesh = meshlib.make_mesh(dp=-1)
-    n_chips = mesh.size
-
     model = resnet.build_resnet50({"num_classes": 1000, "bf16": True})
     variables = resnet.init_variables(model, jax.random.PRNGKey(0), image_size)
     optimizer = optax.sgd(0.1, momentum=0.9, nesterov=True)
-
     params = meshlib.shard_tree(
         mesh, variables["params"],
         jax.tree.map(lambda _: meshlib.replicated(mesh), variables["params"]))
@@ -60,8 +56,20 @@ def bench_resnet50(batch_size: int = 256, image_size: int = 224,
         mesh, variables["batch_stats"],
         jax.tree.map(lambda _: meshlib.replicated(mesh), variables["batch_stats"]))
     state = dplib.BNTrainState.create(params, batch_stats, optimizer)
-
     loss_fn = resnet.make_loss_fn(model, weight_decay=1e-4)
+    return state, loss_fn, optimizer
+
+
+def bench_resnet50(batch_size: int = 256, image_size: int = 224,
+                   warmup: int = 3, steps: int = 20) -> dict:
+    import numpy as np
+
+    from tensorflowonspark_tpu.parallel import dp as dplib
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(dp=-1)
+    n_chips = mesh.size
+    state, loss_fn, optimizer = _make_bench_state(mesh, image_size)
     step_fn = dplib.make_bn_train_step(loss_fn, optimizer)
 
     # Synthetic device-resident batch: the bench isolates the train-step
@@ -96,6 +104,92 @@ def bench_resnet50(batch_size: int = 256, image_size: int = 224,
     }
 
 
+def bench_resnet50_tfrecord(batch_size: int = 256, image_size: int = 224,
+                            warmup: int = 3, steps: int = 20,
+                            dataset_images: int = 2048) -> float:
+    """End-to-end variant: the same train step fed from TFRecord shards.
+
+    Covers the full input pipeline the synthetic bench skips — TFRecord
+    framing (native codec), Example proto parse, batch assembly, and the
+    host→device transfer — overlapped with the device step via the
+    double-buffered prefetch iterator.  Images ride as uint8 bytes features
+    (the ImageNet TFRecord idiom; 4x smaller than float lists) and are
+    normalized to float INSIDE jit, so the host never touches a float image.
+
+    Returns end-to-end images/sec.
+    """
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu import dfutil, tfrecord
+    from tensorflowonspark_tpu.parallel import dp as dplib
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(dp=-1)
+
+    # -- write the dataset once per process (page cache serves re-reads) ----
+    data_dir = os.path.join(tempfile.gettempdir(),
+                            f"bench_tfr_{image_size}_{dataset_images}")
+    shards = [os.path.join(data_dir, f"part-{i:05d}.tfrecord") for i in range(4)]
+    if not all(os.path.exists(s) for s in shards):
+        os.makedirs(data_dir, exist_ok=True)
+        rng = np.random.RandomState(0)
+        per = dataset_images // len(shards)
+        for si, shard in enumerate(shards):
+            def gen():
+                for j in range(per):
+                    img = rng.randint(0, 256, (image_size, image_size, 3),
+                                      np.uint8)
+                    yield dfutil.to_example({"image": img.tobytes(),
+                                            "label": (si * per + j) % 1000})
+            tfrecord.write_records(shard, gen())
+
+    def batches():
+        """Cycle shards forever, yielding device-ready sharded batches."""
+        imgs = np.empty((batch_size, image_size, image_size, 3), np.uint8)
+        labels = np.empty((batch_size,), np.int32)
+        n = 0
+        while True:
+            for shard in shards:
+                for buf in tfrecord.read_records(shard):
+                    row = dfutil.from_example(buf, binary_features={"image"})
+                    imgs[n] = np.frombuffer(row["image"][0], np.uint8).reshape(
+                        image_size, image_size, 3)
+                    labels[n] = row["label"][0]
+                    n += 1
+                    if n == batch_size:
+                        yield meshlib.shard_batch(
+                            mesh, {"image": imgs.copy(), "label": labels.copy()})
+                        n = 0
+
+    state, base_loss, optimizer = _make_bench_state(mesh, image_size)
+
+    def loss_fn(params, batch_stats, batch):
+        # uint8 -> normalized float happens on-chip; XLA fuses it into the
+        # first conv's input, and the PCIe/ICI transfer stays 4x smaller.
+        image = batch["image"].astype(jnp.float32) / 255.0
+        return base_loss(params, batch_stats,
+                         {"image": image, "label": batch["label"]})
+
+    step_fn = dplib.make_bn_train_step(loss_fn, optimizer)
+
+    it = dplib._prefetch_iterator(batches(), depth=2)
+    try:
+        for _ in range(warmup):
+            state, metrics = step_fn(state, next(it))
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, next(it))
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+    finally:
+        it.close()
+    return batch_size * steps / dt
+
+
 def _child_main() -> None:
     """Runs in the bench subprocess: OOM-backoff loop, prints the JSON line."""
     batch_size = int(sys.argv[2]) if len(sys.argv) > 2 else 256
@@ -111,7 +205,24 @@ def _child_main() -> None:
     else:
         print(json.dumps(_zero_json("all batch sizes OOMed")))
         sys.exit(1)
+    # Emit the primary metric NOW: the supplementary e2e run below may hang
+    # past the parent's timeout, and a hang must not destroy an already-valid
+    # measurement (the parent keeps the LAST parseable JSON line it sees).
+    print(json.dumps(result), flush=True)
+    try:
+        e2e = bench_resnet50_tfrecord(batch_size=batch_size)
+        result["e2e_tfrecord_images_per_sec"] = round(e2e, 1)
+        result["e2e_frac_of_synthetic"] = round(
+            e2e / (result["value"] * max(1, _mesh_size())), 3)
+    except Exception as e:  # noqa: BLE001 - e2e is supplementary evidence
+        result["e2e_error"] = str(e)[:300]
     print(json.dumps(result))
+
+
+def _mesh_size() -> int:
+    import jax
+
+    return len(jax.devices())
 
 
 def _zero_json(error: str) -> dict:
@@ -149,33 +260,47 @@ def main() -> None:
         sys.exit(1)
 
     here = os.path.abspath(__file__)
-    try:
-        proc = subprocess.run(
-            [sys.executable, here, "--child"],
-            timeout=BENCH_TIMEOUT_S, stdout=subprocess.PIPE,
-            stderr=sys.stderr, text=True, cwd=os.path.dirname(here))
-    except subprocess.TimeoutExpired:
-        print(json.dumps(_zero_json(
-            f"bench timed out after {BENCH_TIMEOUT_S}s (probe was: {detail})")))
-        sys.exit(1)
-
     json_line = None
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                json_line = json.loads(line)
-            except json.JSONDecodeError:
-                pass
-        else:
-            print(line, file=sys.stderr)
+    # Two attempts: the tunnel occasionally drops a remote_compile stream
+    # mid-flight (transient INTERNAL errors); a fresh subprocess usually
+    # succeeds immediately after.
+    for attempt in (1, 2):
+        rc = 0
+        try:
+            proc = subprocess.run(
+                [sys.executable, here, "--child"],
+                timeout=BENCH_TIMEOUT_S, stdout=subprocess.PIPE,
+                stderr=sys.stderr, text=True, cwd=os.path.dirname(here))
+            stdout, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            # The child prints the primary metric before the supplementary
+            # e2e phase; salvage it from the captured partial output.
+            stdout = e.stdout or ""
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode(errors="replace")
+            if "{" not in stdout:
+                print(json.dumps(_zero_json(
+                    f"bench timed out after {BENCH_TIMEOUT_S}s (probe was: {detail})")))
+                sys.exit(1)
+            print(f"bench e2e phase timed out; keeping primary metric",
+                  file=sys.stderr)
+        for line in stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    json_line = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+            else:
+                print(line, file=sys.stderr)
+        if json_line is not None:
+            break
+        print(f"bench attempt {attempt}: no JSON (rc={rc}); "
+              f"{'retrying' if attempt == 1 else 'giving up'}", file=sys.stderr)
     if json_line is None:
-        print(json.dumps(_zero_json(
-            f"bench subprocess produced no JSON (rc={proc.returncode})")))
+        print(json.dumps(_zero_json(f"bench subprocess produced no JSON (rc={rc})")))
         sys.exit(1)
     print(json.dumps(json_line))
-    if proc.returncode != 0:
-        sys.exit(proc.returncode)
 
 
 if __name__ == "__main__":

@@ -205,6 +205,58 @@ def load_bundle(export_dir: str) -> tuple[Any, dict]:
     return params, config
 
 
+def export_stablehlo(export_dir: str, params: Any, model_config: dict,
+                     input_shape: tuple, input_dtype: Any = None,
+                     batch_polymorphic: bool = True,
+                     platforms: tuple = ("cpu", "tpu")) -> str:
+    """Serving interop: export a self-contained StableHLO artifact.
+
+    The reference's SavedModel was consumable by anything speaking TF serving
+    (``TFNode.py:~160-230``); the bundle format is registry-bound to this
+    repo.  This writes ``model.stablehlo`` — the jitted apply fn with the
+    params **baked in as constants**, serialized via ``jax.export`` — so a
+    consumer needs only ``jax`` (any version with the same serialization
+    era), no model registry, no flax, no this-package:
+
+        exp = jax.export.deserialize(open("model.stablehlo", "rb").read())
+        logits = exp.call(images)
+
+    ``input_shape`` excludes the batch dim when ``batch_polymorphic`` (the
+    default): the artifact then scores any batch size via a symbolic
+    dimension.  ``platforms`` bakes in the lowerings to ship (cpu + tpu by
+    default, so the same artifact serves on either).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from jax import export as jexport
+    from tensorflowonspark_tpu.models.registry import build_apply
+
+    apply_fn = build_apply(model_config)
+    dtype = input_dtype or jnp.float32
+    device_params = jax.tree.map(jnp.asarray, params)
+
+    if batch_polymorphic:
+        (b,) = jexport.symbolic_shape("b")
+        spec = jax.ShapeDtypeStruct((b, *input_shape), dtype)
+    else:
+        spec = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
+    exp = jexport.export(
+        jax.jit(lambda x: apply_fn(device_params, x)),
+        platforms=list(platforms))(spec)
+
+    local = resolve_uri(export_dir)
+    os.makedirs(local, exist_ok=True)
+    with open(os.path.join(local, "model.stablehlo"), "wb") as f:
+        f.write(exp.serialize())
+    meta = {"model_config": model_config, "platforms": list(platforms),
+            "input_shape": list(input_shape),
+            "batch_polymorphic": batch_polymorphic}
+    with open(os.path.join(local, "stablehlo.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    return local
+
+
 _BUNDLE_CACHE: dict[str, tuple[Any, dict, Callable]] = {}
 
 

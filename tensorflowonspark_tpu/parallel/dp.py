@@ -146,6 +146,7 @@ def make_batch_iterator(
     mesh=None,
     ctx=None,
     pad_to_batch: bool = True,
+    prefetch: int = 2,
 ):
     """Drain a DataFeed into device-ready, mesh-sharded batches.
 
@@ -153,7 +154,84 @@ def make_batch_iterator(
     final batches are padded (repeating the last sample) and, when ``ctx`` is
     given, a control-plane ``all_done`` consensus decides when *all* hosts
     stop — no host may exit the step loop early.
+
+    ``prefetch`` > 0 double-buffers the host side (SURVEY.md §7.3-6): a
+    background thread drains the feed, converts (``to_arrays``) and starts
+    the host→device transfer (``shard_batch``) for batch N+1 while the
+    caller's jitted step N is still executing — the conversion/transfer cost
+    disappears behind the device step instead of serializing with it.  Set
+    ``prefetch=0`` for strictly synchronous delivery.
     """
+    inner = _batch_iterator(feed, batch_size, to_arrays, mesh, ctx, pad_to_batch)
+    if prefetch <= 0:
+        yield from inner
+        return
+    yield from _prefetch_iterator(inner, prefetch)
+
+
+def _prefetch_iterator(inner, depth: int):
+    """Run ``inner`` on a background thread through a bounded queue.
+
+    An abandoned consumer (early ``break`` → ``GeneratorExit``) must not
+    leave the producer blocked on a full queue holding the feed: ``close()``
+    sets a stop flag and drains, and the producer re-checks it around every
+    put.  Producer exceptions re-raise at the consumer's next pull — the same
+    point they would have surfaced unprefetched.
+    """
+    import queue as _queue
+    import threading
+
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    END = object()
+    failure: list[BaseException] = []
+
+    def _produce() -> None:
+        try:
+            for item in inner:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+            failure.append(e)
+        finally:
+            inner.close()
+            while not stop.is_set():
+                try:
+                    q.put(END, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
+
+    thread = threading.Thread(target=_produce, name="batch-prefetch", daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                break
+            yield item
+        if failure:
+            raise failure[0]
+        thread.join()
+    finally:
+        stop.set()
+        thread.join(timeout=30.0)
+
+
+def _batch_iterator(
+    feed,
+    batch_size: int,
+    to_arrays: Callable[[list], Any],
+    mesh=None,
+    ctx=None,
+    pad_to_batch: bool = True,
+):
     from tensorflowonspark_tpu.parallel.mesh import shard_batch
 
     if getattr(feed, "input_mapping", None):

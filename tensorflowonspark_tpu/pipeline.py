@@ -243,6 +243,13 @@ class TPUEstimator(TPUParams):
 
     ``train_fn`` must export a bundle to ``args.export_dir`` (the reference's
     map_fun exported a SavedModel the same way).
+
+    ``epochs`` semantics by input mode (same split as the reference): in
+    STREAMING mode the *driver* replays the dataset ``epochs`` times through
+    the feed; in DIRECT mode the framework never touches the data, so the
+    train_fn owns the epoch loop and reads ``args.epochs`` itself (as
+    ``examples/mnist/mnist_tfr.py`` does) — the Param is plumbed through
+    either way.
     """
 
     def __init__(self, train_fn: Callable, tf_args: Any = None, **params: Any):

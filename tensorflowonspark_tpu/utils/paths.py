@@ -17,10 +17,31 @@ from urllib.parse import urlparse
 # scheme -> local root that backs it (e.g. a FUSE mountpoint).
 _FS_ROOTS: dict[str, str] = {}
 
+# Env carrier so registrations survive into spawned node processes: the
+# launchers pass os.environ through to children (the same way Spark shipped
+# the Hadoop conf to executors), so a driver-side register_fs_root is
+# visible inside every node's resolve_uri without extra plumbing.
+_ENV_KEY = "TOS_FS_ROOTS"
 
-def register_fs_root(scheme: str, local_root: str) -> None:
-    """Map a filesystem scheme (``hdfs``, ``hopsfs``, ``gs``) to a local root."""
+
+def register_fs_root(scheme: str, local_root: str, export: bool = True) -> None:
+    """Map a filesystem scheme (``hdfs``, ``hopsfs``, ``gs``) to a local root.
+
+    ``export=True`` (default) also records the mapping in ``os.environ`` so
+    node processes launched afterwards inherit it.
+    """
+    _load_env_roots()  # don't drop inherited mappings when re-exporting
     _FS_ROOTS[scheme.rstrip(":/")] = local_root
+    if export:
+        os.environ[_ENV_KEY] = os.pathsep.join(
+            f"{s}={r}" for s, r in sorted(_FS_ROOTS.items()))
+
+
+def _load_env_roots() -> None:
+    for pair in os.environ.get(_ENV_KEY, "").split(os.pathsep):
+        if "=" in pair:
+            scheme, root = pair.split("=", 1)
+            _FS_ROOTS.setdefault(scheme, root)
 
 
 def resolve_uri(path: str) -> str:
@@ -32,6 +53,8 @@ def resolve_uri(path: str) -> str:
     parsed = urlparse(path)
     if parsed.scheme in ("", "file"):
         return parsed.path if parsed.scheme == "file" else path
+    if parsed.scheme not in _FS_ROOTS:
+        _load_env_roots()
     root = _FS_ROOTS.get(parsed.scheme)
     if root is None:
         raise ValueError(

@@ -1,6 +1,8 @@
 """SPMD data-parallel training tests on the virtual 8-device CPU mesh
 (the reference's local-cluster analogue for mesh logic, SURVEY.md §4)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -111,3 +113,40 @@ def test_batch_iterator_pads_final_batch():
     sizes = [(b.shape[0], n) for b, n in batches]
     assert sizes == [(4, 4), (4, 4), (4, 2)]
     assert batches[-1][0].tolist() == [8, 9, 9, 9]  # padded with last sample
+
+
+def test_batch_iterator_prefetch_matches_sync():
+    """The double-buffered path must deliver byte-identical batches in the
+    same order as strictly-synchronous delivery (SURVEY.md §7.3-6)."""
+    sync = list(make_batch_iterator(feed_with(list(range(23))), 4,
+                                    to_arrays=np.asarray, prefetch=0))
+    pre = list(make_batch_iterator(feed_with(list(range(23))), 4,
+                                   to_arrays=np.asarray, prefetch=3))
+    assert [n for _, n in sync] == [n for _, n in pre]
+    for (a, _), (b, _) in zip(sync, pre):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_batch_iterator_prefetch_propagates_errors():
+    def bad_to_arrays(xs):
+        raise ValueError("conversion exploded")
+
+    it = make_batch_iterator(feed_with([1, 2, 3]), 2, to_arrays=bad_to_arrays)
+    with pytest.raises(ValueError, match="conversion exploded"):
+        list(it)
+
+
+def test_batch_iterator_prefetch_abandoned_consumer_unblocks():
+    """An early break must stop the producer thread promptly instead of
+    leaving it blocked on the bounded queue holding the feed."""
+    import threading
+
+    before = threading.active_count()
+    it = make_batch_iterator(feed_with(list(range(100))), 2,
+                             to_arrays=np.asarray, prefetch=1)
+    next(it)
+    it.close()  # GeneratorExit -> stop flag -> producer exits
+    deadline = time.monotonic() + 10.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "prefetch thread leaked"

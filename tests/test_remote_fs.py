@@ -1,0 +1,74 @@
+"""Remote-filesystem (FUSE-mount contract) end-to-end test — VERDICT r2
+item 9; reference parity ``TFNode.hdfs_path`` + Hadoop FS I/O
+(``tensorflowonspark/TFNode.py:~30-70``, ``dfutil.py:~30-90``).
+
+Every path in the job is a ``hopsfs://`` URI backed by a registered local
+root (the FUSE-mountpoint production shape).  Registration happens once in
+the driver; spawned node processes inherit it through the ``TOS_FS_ROOTS``
+env carrier — nothing re-registers inside map_funs.  Covered end-to-end:
+TFRecord write + sharded read, checkpoint save/restore, TensorBoard summary
+write, bundle export + load — all through URIs, in real node processes.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import tensorflowonspark_tpu as tos
+from tensorflowonspark_tpu.utils.paths import register_fs_root, resolve_uri
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples", "mnist")
+if EXAMPLES not in sys.path:
+    sys.path.insert(0, EXAMPLES)
+
+import mnist_dist  # noqa: E402
+import mnist_tfr  # noqa: E402
+
+TINY = {"features": [4, 8], "dense": 16, "batch_size": 16, "lr": 0.05}
+
+
+def test_hopsfs_uri_end_to_end(tmp_path):
+    register_fs_root("hopsfs", str(tmp_path))
+    assert resolve_uri("hopsfs://nn/a/b") == str(tmp_path / "a" / "b")
+
+    # -- config 2: TFRecord shards written and read through the URI --------
+    data_uri = "hopsfs://namenode/mnist/tfr"
+    mnist_tfr.prepare_data(data_uri, samples=160, partitions=2)
+    assert (tmp_path / "mnist" / "tfr" / "_schema.json").exists()
+
+    args = {**TINY, "data_dir": data_uri,
+            "export_dir": "hopsfs://namenode/mnist/export", "epochs": 1}
+    c1 = tos.run(mnist_tfr.main_fun, args, num_executors=2,
+                 input_mode=tos.InputMode.DIRECT,
+                 log_dir=str(tmp_path / "nodelogs1"), reservation_timeout=120)
+    c1.shutdown(timeout=300)
+    # bundle landed under the mapped root, written by a node process
+    assert (tmp_path / "mnist" / "export" / "bundle.json").exists()
+
+    # -- config 1: checkpoints + summaries through URIs --------------------
+    args2 = {**TINY, "model_dir": "hopsfs://namenode/mnist/model",
+             "log_dir": "hopsfs://namenode/mnist/logs"}
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(64), 2)
+    c2 = tos.run(mnist_dist.main_fun, args2, num_executors=1,
+                 input_mode=tos.InputMode.STREAMING,
+                 log_dir=str(tmp_path / "nodelogs2"), reservation_timeout=120)
+    c2.train(data)
+    c2.shutdown(timeout=300)
+    assert glob.glob(str(tmp_path / "mnist" / "logs" / "train" /
+                         "events.out.tfevents.*"))
+
+    # -- restore + bundle load back through the URIs (driver side) ---------
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager, load_bundle
+
+    restored = CheckpointManager("hopsfs://namenode/mnist/model").restore_latest()
+    assert restored is not None
+    tree, step = restored
+    assert step > 0 and "params" in tree
+
+    params, config = load_bundle("hopsfs://namenode/mnist/export")
+    assert config["model"] == "mnist_cnn"
