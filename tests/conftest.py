@@ -26,6 +26,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # with the real-chip env restored (tests/test_tpu_smoke.py).
 os.environ.setdefault("TPU_SMOKE_POOL_IPS", os.environ.get("PALLAS_AXON_POOL_IPS", ""))
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# Persistent XLA compilation cache: the suite compiles the same tiny models
+# over and over (every spawned node process recompiles its train step, and
+# CI reruns the identical suite), and on this 1-core box XLA:CPU compiles
+# dominate wall-clock.  Measured: ResNet-18 init+fwd 19.8s cold -> 3.6s
+# cached.  Spawned nodes inherit the env.
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+from xla_cache_bootstrap import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -68,3 +68,32 @@ def test_two_process_jax_distributed_psum(tmp_path):
     for m in cluster.coordinator.cluster_info():
         assert m["device"]["platform"] == "cpu"
         assert m["device"]["num_devices"] == 2
+
+
+@pytest.mark.slow
+def test_pod_launcher_local_transport_two_hosts(tmp_path):
+    """A '2-host pod' on localhost through TPUPodLauncher(transport='local'):
+    the launcher must compose per-host env, ship configs over stdin, force
+    jax_distributed, and the two node processes must form one global mesh —
+    the pod path end-to-end minus ssh (reference: Spark executor placement,
+    ``TFCluster.py:~340-360``)."""
+    from tensorflowonspark_tpu.launcher import TPUPodLauncher
+
+    pod = TPUPodLauncher(hosts=["localhost", "localhost"], transport="local",
+                         platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        _dist_map_fun,
+        None,
+        num_executors=2,
+        input_mode=tcluster.InputMode.DIRECT,
+        launcher=pod,
+        log_dir=str(tmp_path),
+        reservation_timeout=180,
+    )
+    cluster.shutdown(timeout=300.0)
+    infos = [m.get("dist_check") for m in cluster.coordinator.cluster_info()]
+    assert all(i is not None for i in infos), f"missing dist_check: {infos}"
+    for info in infos:
+        assert info["process_count"] == 2
+        assert info["global_devices"] == 4
+        assert info["global_sum"] == 6.0

@@ -14,9 +14,10 @@ from tensorflowonspark_tpu.inference import bundle_inference_loop
 def test_inception_forward_shape():
     """Full v3 topology at the smallest legal input (75x75, fully-conv)."""
     model = inception.InceptionV3(num_classes=10, compute_dtype=jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 75, 75, 3), jnp.float32), train=True)
-    logits = model.apply(variables, jnp.zeros((2, 75, 75, 3)), train=False)
+    variables = jax.jit(lambda k: model.init(
+        k, jnp.zeros((1, 75, 75, 3), jnp.float32), train=True))(jax.random.PRNGKey(0))
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, jnp.zeros((2, 75, 75, 3)))
     assert logits.shape == (2, 10)
     # channel plan sanity: final concat before pool is 2048 channels
     assert variables["params"]["head"]["kernel"].shape[0] == 2048

@@ -68,7 +68,7 @@ def test_restart_resumes_from_checkpoint(tmp_path):
     from tensorflowonspark_tpu.models.mnist import synthetic_mnist
 
     args = {**TINY, "model_dir": str(tmp_path / "model")}
-    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(96), 2)
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(64), 2)
 
     c1 = tos.run(mnist_dist.main_fun, args, num_executors=1,
                  input_mode=tos.InputMode.STREAMING,

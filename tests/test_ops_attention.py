@@ -35,8 +35,8 @@ def test_blockwise_grads_match_reference():
     def loss_blk(q, k, v):
         return jnp.sum(att.blockwise_attention(q, k, v, causal=True, block_k=8) ** 2)
 
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    g_blk = jax.jit(jax.grad(loss_blk, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_ref, g_blk):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
@@ -65,11 +65,11 @@ def test_pallas_backward_is_blockwise_recompute():
     def loss(fn):
         return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
 
-    g_pal = jax.grad(loss(lambda q, k, v: att.flash_attention(
+    g_pal = jax.jit(jax.grad(loss(lambda q, k, v: att.flash_attention(
         q, k, v, block_q=16, block_k=16, impl="pallas_interpret")),
-        argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(loss(lambda q, k, v: att.mha_reference(q, k, v)),
-                     argnums=(0, 1, 2))(q, k, v)
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss(lambda q, k, v: att.mha_reference(q, k, v)),
+                    argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_pal, g_ref):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 

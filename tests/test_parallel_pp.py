@@ -95,8 +95,8 @@ def test_gpipe_gradients_match_sequential():
     stacked = pplib.stack_stages(trees)
     x = jnp.asarray(np.random.RandomState(4).randn(8, 8), jnp.float32)
 
-    g_pipe = jax.grad(lambda p: jnp.sum(
-        pplib.gpipe(stage_fn, p, x, mesh=mesh, n_microbatches=2) ** 2))(stacked)
+    g_pipe = jax.jit(jax.grad(lambda p: jnp.sum(
+        pplib.gpipe(stage_fn, p, x, mesh=mesh, n_microbatches=2) ** 2)))(stacked)
 
     def seq_loss(p):
         out = x
@@ -104,7 +104,7 @@ def test_gpipe_gradients_match_sequential():
             out = stage_fn(jax.tree.map(lambda a: a[i], p), out)
         return jnp.sum(out ** 2)
 
-    g_seq = jax.grad(seq_loss)(stacked)
+    g_seq = jax.jit(jax.grad(seq_loss))(stacked)
     for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)

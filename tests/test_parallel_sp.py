@@ -58,8 +58,9 @@ def test_ring_attention_grads_match_dense():
     def loss_ref(q, k, v):
         return jnp.sum(att.mha_reference(q, k, v, causal=True).astype(jnp.float32) ** 2)
 
-    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    # jit the grads: one cached program instead of op-by-op eager tracing
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)

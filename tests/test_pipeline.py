@@ -63,7 +63,7 @@ class TestNamespace:
 
 class TestFitTransform:
     def test_fit_then_transform(self, tmp_path):
-        rows = wide_deep.synthetic_criteo(96, seed=1)
+        rows = wide_deep.synthetic_criteo(48, seed=1)
         data = PartitionedDataset.from_iterable(rows, 4)
         est = pipeline.TPUEstimator(
             mapfuns.train_wide_deep,

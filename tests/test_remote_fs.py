@@ -36,7 +36,7 @@ def test_hopsfs_uri_end_to_end(tmp_path):
 
     # -- config 2: TFRecord shards written and read through the URI --------
     data_uri = "hopsfs://namenode/mnist/tfr"
-    mnist_tfr.prepare_data(data_uri, samples=160, partitions=2)
+    mnist_tfr.prepare_data(data_uri, samples=96, partitions=2)
     assert (tmp_path / "mnist" / "tfr" / "_schema.json").exists()
 
     args = {**TINY, "data_dir": data_uri,

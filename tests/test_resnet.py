@@ -22,8 +22,10 @@ def tiny_resnet():
 
 
 def make_state(model, mesh, optimizer):
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 32, 32, 3), jnp.float32), train=True)
+    # jit the init: one (persistently cached) XLA program instead of
+    # hundreds of eager per-op compiles — 1-core-box wall-clock hygiene
+    variables = jax.jit(lambda k: model.init(
+        k, jnp.zeros((1, 32, 32, 3), jnp.float32), train=True))(jax.random.PRNGKey(0))
     params = meshlib.shard_tree(mesh, variables["params"])
     batch_stats = meshlib.shard_tree(
         mesh, variables["batch_stats"],
@@ -41,9 +43,10 @@ def make_batch(mesh, n=16, num_classes=8, seed=0):
 
 def test_forward_shapes():
     model = tiny_resnet()
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 32, 32, 3), jnp.float32), train=True)
-    logits = model.apply(variables, jnp.zeros((4, 32, 32, 3)), train=False)
+    variables = jax.jit(lambda k: model.init(
+        k, jnp.zeros((1, 32, 32, 3), jnp.float32), train=True))(jax.random.PRNGKey(0))
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, jnp.zeros((4, 32, 32, 3)))
     assert logits.shape == (4, 8)
     assert logits.dtype == jnp.float32
 
@@ -109,8 +112,8 @@ def test_graft_entry_forward_tiny():
     """entry() builds the real ResNet-50; too big for CPU CI — check the
     callable contract on a tiny clone instead."""
     model = tiny_resnet()
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 32, 32, 3), jnp.float32), train=True)
+    variables = jax.jit(lambda k: model.init(
+        k, jnp.zeros((1, 32, 32, 3), jnp.float32), train=True))(jax.random.PRNGKey(0))
 
     def forward(params, batch_stats, images):
         return model.apply({"params": params, "batch_stats": batch_stats},

@@ -1,0 +1,36 @@
+"""Shared persistent-XLA-cache bootstrap for the repo's entry points.
+
+The test conftest, the driver gate (``__graft_entry__``) and the bench all
+recompile identical XLA programs run after run; the persistent cache cuts
+those compiles to sub-second loads.  Two subtleties this helper owns:
+
+- the env vars must be in ``os.environ`` before *any* jax import so spawned
+  child processes inherit them;
+- jax snapshots env into ``jax.config`` at import, and a pytest plugin (or
+  the caller) may have imported jax already — so the config is re-asserted
+  afterwards, honouring any user override of the env values.
+
+Kept as a repo-root stdlib-only module (not inside the package) because the
+package ``__init__`` itself imports jax — importing a helper from there
+would defeat the env-before-import requirement.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(default_dir: str | None = None) -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          default_dir or os.path.join(here, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+    import jax
+
+    want_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    if jax.config.jax_compilation_cache_dir != want_dir:
+        jax.config.update("jax_compilation_cache_dir", want_dir)
+    want_min = float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"])
+    if jax.config.jax_persistent_cache_min_compile_time_secs != want_min:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", want_min)
