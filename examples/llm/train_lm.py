@@ -53,6 +53,10 @@ def main() -> None:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--ep", type=int, default=1)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--vocab-chunk", type=int, default=0,
+                   help=">0 fuses the lm_head into a blockwise cross-entropy "
+                        "(ops/xent.py) — never materializes [B,S,V] logits; "
+                        "use with tp=1")
     p.add_argument("--profile-dir", default="",
                    help="write a jax profiler trace of the steady state here")
     args = p.parse_args()
@@ -93,7 +97,8 @@ def main() -> None:
         shardings = tplib.compose_fsdp(mesh, params, shardings)
         params = meshlib.shard_tree(mesh, params, shardings)
         state = dplib.TrainState.create(params, optimizer)
-        step = dplib.make_train_step(tfm.make_loss_fn(model), optimizer)
+        step = dplib.make_train_step(
+            tfm.make_loss_fn(model, vocab_chunk=args.vocab_chunk), optimizer)
         batch = meshlib.shard_batch(mesh, {"input_ids": np.asarray(ids)})
 
         state, metrics = step(state, batch)  # compile

@@ -181,16 +181,62 @@ def chief_save(ctx, manager: CheckpointManager, step: int, tree: Any,
 
 # -- inference bundles (SavedModel analogue) ---------------------------------
 
+def _flatten_tree(tree: Any, prefix: str = "") -> dict:
+    """Nested dict-of-arrays -> flat {'a/b/c': array} (bundle npz keys)."""
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            flat.update(_flatten_tree(v, key))
+    else:
+        flat[prefix] = tree
+    return flat
+
+
+def _unflatten_tree(flat: dict) -> Any:
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
 def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
     """Export params + config for serving (reference ``export_saved_model``).
 
     ``model_config`` must contain everything needed to rebuild the apply fn
     (e.g. ``{"model": "mnist_cnn", "num_classes": 10}``); the model registry
     in ``models/`` resolves it at load time.
+
+    Params ride in a single ``params.npz`` (atomic rename commit), NOT an
+    orbax checkpoint: inference nodes then never import orbax, whose import
+    alone costs ~7s of CPU — a real tax when a cluster spawns a scoring
+    process per executor (train-state checkpoints keep orbax: they are
+    sharded, async, and large; bundles are small flat trees).
+
+    Cross-process-sharded leaves (multi-host FSDP/tp params, not fetchable
+    via ``np.asarray``) fall back to the orbax layout, which serializes
+    sharded jax.Arrays natively; ``load_bundle`` reads either layout.
     """
+    import numpy as np
+
     local = resolve_uri(export_dir)
     os.makedirs(local, exist_ok=True)
-    save_checkpoint(os.path.join(export_dir, "params"), params)
+    flat_leaves = _flatten_tree(params)
+    if any(not getattr(v, "is_fully_addressable", True)
+           for v in flat_leaves.values()):
+        save_checkpoint(os.path.join(export_dir, "params"), params)
+        with open(os.path.join(local, "bundle.json"), "w") as f:
+            json.dump(model_config, f, indent=2, sort_keys=True)
+        return local
+    flat = {k: np.asarray(v) for k, v in flat_leaves.items()}
+    tmp = os.path.join(local, "params.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, os.path.join(local, "params.npz"))
     with open(os.path.join(local, "bundle.json"), "w") as f:
         json.dump(model_config, f, indent=2, sort_keys=True)
     return local
@@ -198,10 +244,17 @@ def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
 
 def load_bundle(export_dir: str) -> tuple[Any, dict]:
     """Load an exported bundle -> (params, model_config)."""
+    import numpy as np
+
     local = resolve_uri(export_dir)
     with open(os.path.join(local, "bundle.json")) as f:
         config = json.load(f)
-    params = restore_checkpoint(os.path.join(export_dir, "params"))
+    npz = os.path.join(local, "params.npz")
+    if os.path.exists(npz):
+        with np.load(npz) as data:
+            params = _unflatten_tree({k: data[k] for k in data.files})
+    else:  # bundles written before the npz format: orbax layout
+        params = restore_checkpoint(os.path.join(export_dir, "params"))
     return params, config
 
 

@@ -32,7 +32,7 @@ from typing import Any, Callable, Sequence
 from tensorflowonspark_tpu.coordinator import CoordinatorServer
 from tensorflowonspark_tpu.data import as_partitioned
 from tensorflowonspark_tpu.dataserver import DataClient
-from tensorflowonspark_tpu.launcher import LocalLauncher
+from tensorflowonspark_tpu.launcher import LocalLauncher, SubprocessLauncher  # noqa: F401 - LocalLauncher re-exported
 from tensorflowonspark_tpu.node import NodeConfig
 
 logger = logging.getLogger(__name__)
@@ -363,7 +363,11 @@ def run(
         )
         for i in range(num_executors)
     ]
-    launcher = launcher or LocalLauncher()
+    # Default to SubprocessLauncher: children run the lean ``node_entry``
+    # module directly (~0.5s to a live node), where multiprocessing-spawn
+    # re-imports the driver's __main__ machinery in every child (~3s under
+    # pytest), and OS-level env lands before any site hook can import jax.
+    launcher = launcher or SubprocessLauncher()
     launcher.launch(configs, log_dir or None)
     try:
         cluster_info = coordinator.await_registrations(reservation_timeout)

@@ -172,8 +172,10 @@ def build_inception_v3(config: dict) -> InceptionV3:
 
 
 def init_variables(model: InceptionV3, rng: jax.Array, image_size: int = 299):
-    return model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
-                      train=True)
+    from tensorflowonspark_tpu.models.registry import jit_init
+
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return jit_init(model, rng, dummy, train=True)
 
 
 def synthetic_images(n: int, image_size: int = 299, seed: int = 0) -> list[np.ndarray]:

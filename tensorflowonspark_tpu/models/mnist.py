@@ -46,7 +46,10 @@ def build_mnist(config: dict) -> MnistCNN:
 
 
 def init_params(model: MnistCNN, rng: jax.Array, image_shape=(28, 28, 1)):
-    return model.init(rng, jnp.zeros((1, *image_shape), jnp.float32))["params"]
+    from tensorflowonspark_tpu.models.registry import jit_init
+
+    dummy = jnp.zeros((1, *image_shape), jnp.float32)
+    return jit_init(model, rng, dummy)["params"]
 
 
 def make_loss_fn(model: MnistCNN):

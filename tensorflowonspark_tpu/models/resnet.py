@@ -71,16 +71,25 @@ class ResNet(nn.Module):
     width: int = 64
     compute_dtype: Any = jnp.bfloat16
     norm_dtype: Any = jnp.bfloat16
+    # "imagenet": 7x7/2 stem + 3x3/2 maxpool (224px inputs);
+    # "cifar": 3x3/1 stem, no pool (32px inputs — the reference's cifar10
+    # example family, ``examples/cifar10``).
+    stem: str = "imagenet"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.compute_dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=self.compute_dtype, name="conv_init")(x)
+        if self.stem == "cifar":
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        dtype=self.compute_dtype, name="conv_init")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                        dtype=self.compute_dtype, name="conv_init")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.norm_dtype, name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if self.stem != "cifar":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, size in enumerate(self.stage_sizes):
             for block in range(size):
                 strides = 2 if stage > 0 and block == 0 else 1
@@ -121,10 +130,28 @@ def build_resnet18(config: dict) -> ResNet:
     )
 
 
+@register("resnet_cifar")
+def build_resnet_cifar(config: dict) -> ResNet:
+    """CIFAR-size ResNet (bottleneck, 3x3 stem, no maxpool) — the TPU
+    counterpart of the reference's ``examples/cifar10`` model family.
+    ``depth_blocks`` n gives 9n+2 layers (default n=3 → ResNet-29)."""
+    n = config.get("depth_blocks", 3)
+    return ResNet(
+        stage_sizes=(n, n, n),
+        num_classes=config.get("num_classes", 10),
+        width=config.get("width", 16),
+        stem="cifar",
+        **_dtypes(config),
+    )
+
+
 def init_variables(model: ResNet, rng: jax.Array, image_size: int = 224):
-    """Init {'params', 'batch_stats'} with a single dummy image."""
-    return model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
-                      train=True)
+    """Init {'params', 'batch_stats'} with a single dummy image (jitted,
+    see ``registry.jit_init``)."""
+    from tensorflowonspark_tpu.models.registry import jit_init
+
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return jit_init(model, rng, dummy, train=True)
 
 
 def make_loss_fn(model: ResNet, weight_decay: float = 1e-4):

@@ -202,6 +202,10 @@ class Transformer(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     decode: bool = False
     max_decode_len: int = 0
+    # Return final_norm hidden states instead of logits (the lm_head matmul
+    # is then fused into a blockwise loss — see ops/xent.py).  Init with the
+    # default model so lm_head params exist; apply may skip them.
+    return_hidden: bool = False
 
     @nn.compact
     def __call__(self, input_ids):
@@ -217,6 +221,8 @@ class Transformer(nn.Module):
                       self.compute_dtype, self.decode, self.max_decode_len,
                       name=f"block_{i}")(x)
         x = RMSNorm(name="final_norm")(x)
+        if self.return_hidden:
+            return x
         logits = nn.Dense(self.vocab_size, use_bias=False, name="lm_head",
                           dtype=self.compute_dtype)(x)
         return constrain(logits.astype(jnp.float32), P(BATCH, "sp", None))
@@ -239,18 +245,18 @@ def build_transformer(config: dict) -> Transformer:
     )
 
 
-def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01):
+def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01,
+                 vocab_chunk: int = 0):
     """Next-token LM loss.  Batch: ``{"input_ids": [B, S] int32}`` (targets
     are inputs shifted left; final position predicts a discarded token).
-    MoE load-balance aux losses are collected from the ``aux_loss`` sow."""
+    MoE load-balance aux losses are collected from the ``aux_loss`` sow.
 
-    def loss_fn(params, batch):
-        ids = batch["input_ids"]
-        logits, updates = model.apply({"params": params}, ids,
-                                      mutable=["aux_loss"])
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-        targets = ids[:, 1:]
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ``vocab_chunk > 0`` fuses the lm_head matmul into a blockwise
+    cross-entropy (``ops/xent.py``): the ``[B, S, V]`` logits are never
+    materialized — the HBM-dominant op at large vocab.  Not for
+    tensor-parallel vocab-sharded heads (use the dense path there)."""
+
+    def _reduce(nll, batch, updates):
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:].astype(jnp.float32)
@@ -260,5 +266,33 @@ def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01):
         aux = sum(jax.tree.leaves(updates.get("aux_loss", {})), 0.0)
         total = loss + aux_loss_coef * aux
         return total, {"lm_loss": loss, "aux_loss": jnp.asarray(aux)}
+
+    if vocab_chunk:
+        from tensorflowonspark_tpu.ops.xent import blockwise_cross_entropy
+
+        hidden_model = model.clone(return_hidden=True)
+
+        def fused_loss_fn(params, batch):
+            ids = batch["input_ids"]
+            h, updates = hidden_model.apply({"params": params}, ids,
+                                            mutable=["aux_loss"])
+            b, s, d = h.shape
+            h = h[:, :-1].reshape(b * (s - 1), d)
+            targets = ids[:, 1:].reshape(-1)
+            nll = blockwise_cross_entropy(
+                h, params["lm_head"]["kernel"].astype(h.dtype), targets,
+                chunk=vocab_chunk)
+            return _reduce(nll.reshape(b, s - 1), batch, updates)
+
+        return fused_loss_fn
+
+    def loss_fn(params, batch):
+        ids = batch["input_ids"]
+        logits, updates = model.apply({"params": params}, ids,
+                                      mutable=["aux_loss"])
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        targets = ids[:, 1:]
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return _reduce(nll, batch, updates)
 
     return loss_fn

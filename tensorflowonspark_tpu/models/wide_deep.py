@@ -73,7 +73,10 @@ def build_wide_deep(config: dict) -> WideDeep:
 
 
 def init_params(model: WideDeep, rng: jax.Array):
-    return model.init(rng, jnp.zeros((1, NUM_NUMERIC + NUM_CATEGORICAL), jnp.float32))["params"]
+    from tensorflowonspark_tpu.models.registry import jit_init
+
+    dummy = jnp.zeros((1, NUM_NUMERIC + NUM_CATEGORICAL), jnp.float32)
+    return jit_init(model, rng, dummy)["params"]
 
 
 def make_loss_fn(model: WideDeep):

@@ -182,7 +182,13 @@ def _apply_jax_env_config() -> None:
     applied — and under ``LocalLauncher`` the env itself lands only inside
     ``node_main``.  Backends initialize lazily, so forcing the config here
     (before any ``jax.devices()`` call) is still early enough.
+
+    If jax is NOT yet imported there is nothing to repair — the (just
+    applied) env vars are honoured at first import — and importing it here
+    would tax every node ~3s whether or not its map_fun ever computes.
     """
+    if "jax" not in sys.modules:
+        return
     import jax
 
     plats = os.environ.get("JAX_PLATFORMS")

@@ -1,0 +1,142 @@
+"""Blockwise (vocab-chunked) softmax cross-entropy for large-vocab LM heads.
+
+The dense LM loss path materializes ``[B, S, V]`` float32 logits twice
+(forward activations + backward cotangents) — at the bench shape
+(8×2048×32000) that is ~2 GB of HBM traffic per direction for a loss whose
+useful output is one scalar per token.  This op never materializes more
+than ``[N, chunk]`` logits: the head matmul, online logsumexp, and the
+softmax-minus-onehot backward are streamed over vocabulary chunks with
+``lax.scan``, recomputing chunk logits in the backward instead of saving
+them (the same recompute-over-residuals trade the flash-attention kernel
+makes — SURVEY.md §5.7 is the design's cousin).
+
+No counterpart exists in the reference (its models are CNNs/wide-and-deep;
+losses are delegated to TF) — this exists because the LM family is
+first-class here.  XLA-level implementation (``lax.scan`` + dot_general with
+f32 accumulation), so it runs on TPU and CPU alike and GSPMD shards the
+token axis; for tensor-parallel vocab sharding use the dense path instead
+(the chunk scan would fight the tp partitioning of the head kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def _pad_vocab(kernel: jax.Array, chunk: int) -> tuple[jax.Array, int]:
+    """Reshape ``[D, V]`` → ``[n_chunks, D, chunk]``, zero-padding V up."""
+    d, v = kernel.shape
+    n_chunks = -(-v // chunk)
+    pad = n_chunks * chunk - v
+    if pad:
+        kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+    return kernel.reshape(d, n_chunks, chunk).transpose(1, 0, 2), n_chunks
+
+
+def _chunk_logits(h: jax.Array, w_c: jax.Array, first_col: jax.Array,
+                  vocab: int) -> jax.Array:
+    """f32 ``[N, chunk]`` logits for one kernel chunk; padded cols → -inf."""
+    logits = jax.lax.dot_general(
+        h, w_c, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    cols = first_col + jnp.arange(w_c.shape[1])
+    return jnp.where(cols[None, :] < vocab, logits, _NEG_INF)
+
+
+def blockwise_cross_entropy(hidden: jax.Array, kernel: jax.Array,
+                            targets: jax.Array, chunk: int = 4096) -> jax.Array:
+    """Per-token ``-log softmax(hidden @ kernel)[target]`` without the
+    ``[N, V]`` materialization.
+
+    Args:
+      hidden: ``[N, D]`` final hidden states (any float dtype; matmuls
+        accumulate in f32).
+      kernel: ``[D, V]`` LM-head kernel.
+      targets: ``[N]`` int32 target ids in ``[0, V)``.
+      chunk: vocab tile width (V is zero-padded up to a multiple).
+
+    Returns: ``[N]`` float32 negative log-likelihoods.
+    """
+    chunk = min(chunk, kernel.shape[1])
+    return _blockwise_xent(hidden, kernel, targets, chunk, kernel.shape[1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _blockwise_xent(hidden, kernel, targets, chunk, vocab):
+    nll, _ = _forward(hidden, kernel, targets, chunk, vocab)
+    return nll
+
+
+def _forward(hidden, kernel, targets, chunk, vocab):
+    n = hidden.shape[0]
+    w_chunks, n_chunks = _pad_vocab(kernel, chunk)
+
+    def body(carry, scan_in):
+        m, s, tgt = carry
+        ci, w_c = scan_in
+        first = ci * chunk
+        logits = _chunk_logits(hidden, w_c, first, vocab)  # [N, chunk]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = targets - first
+        in_chunk = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        tgt = jnp.where(in_chunk, picked, tgt)
+        return (m_new, s, tgt), None
+
+    init = (jnp.full((n,), _NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.full((n,), _NEG_INF, jnp.float32))
+    (m, s, tgt), _ = jax.lax.scan(
+        body, init, (jnp.arange(n_chunks), w_chunks))
+    lse = m + jnp.log(s)
+    return lse - tgt, lse
+
+
+def _fwd(hidden, kernel, targets, chunk, vocab):
+    nll, lse = _forward(hidden, kernel, targets, chunk, vocab)
+    return nll, (hidden, kernel, targets, lse)
+
+
+def _bwd(chunk, vocab, residuals, g):
+    hidden, kernel, targets, lse = residuals
+    w_chunks, n_chunks = _pad_vocab(kernel, chunk)
+
+    def body(dh, scan_in):
+        ci, w_c = scan_in
+        first = ci * chunk
+        logits = _chunk_logits(hidden, w_c, first, vocab)
+        # d nll / d logits = softmax - onehot(target); scale by the incoming
+        # per-token cotangent.  Padded columns have softmax exactly 0.
+        p = jnp.exp(logits - lse[:, None])
+        local = targets - first
+        onehot = ((local[:, None] == jnp.arange(chunk)[None, :])
+                  .astype(jnp.float32))
+        dlogits = (p - onehot) * g[:, None].astype(jnp.float32)
+        dh = dh + jax.lax.dot_general(
+            dlogits, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            hidden, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dh, dw_c
+
+    dh, dw_chunks = jax.lax.scan(
+        body, jnp.zeros(hidden.shape, jnp.float32),
+        (jnp.arange(n_chunks), w_chunks))
+    d = kernel.shape[0]
+    dw = dw_chunks.transpose(1, 0, 2).reshape(d, n_chunks * chunk)
+    dw = dw[:, : kernel.shape[1]]
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)  # int arg: float0
+    return dh.astype(hidden.dtype), dw.astype(kernel.dtype), dtargets
+
+
+_blockwise_xent.defvjp(_fwd, _bwd)

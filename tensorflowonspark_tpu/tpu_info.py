@@ -37,9 +37,43 @@ def is_tpu_available() -> bool:
         return False
 
 
+def _forced_cpu_device_count() -> int:
+    """CPU device count jax will create, from env alone.
+
+    ``JAX_NUM_CPU_DEVICES`` wins (it is what ``chip_visibility_env`` emits
+    per node and overrides the flag inside jax); else the conftest-style
+    ``--xla_force_host_platform_device_count`` in XLA_FLAGS; else 1."""
+    import os
+    import re
+
+    n = os.environ.get("JAX_NUM_CPU_DEVICES")
+    if n:
+        return int(n)
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else 1
+
+
 def device_summary() -> dict:
     """What this process sees; goes into the coordinator registration payload
     so the driver's ``cluster_info`` reports real hardware per node."""
+    import os
+    import sys
+
+    # Env-forced CPU platform and jax not loaded yet: synthesize the summary
+    # instead of paying a ~3s jax import + backend init in every node
+    # process — control-plane-only nodes (and every CPU test node) never
+    # need the backend, and the env already states exactly what it would
+    # report.  Once jax IS loaded (compute nodes), report live state.
+    if "jax" not in sys.modules and os.environ.get(
+            "JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        return {
+            "platform": "cpu",
+            "device_kind": "cpu",
+            "num_devices": _forced_cpu_device_count(),
+            "coords": [],
+            "process_index": 0,
+        }
     try:
         import jax
 

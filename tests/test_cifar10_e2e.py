@@ -1,0 +1,45 @@
+"""CIFAR-10 example-as-test (reference ``examples/cifar10`` family,
+SURVEY.md §4 'Example-as-test'): direct-mode TFRecord training of the
+CIFAR-size ResNet through real node processes on CPU."""
+
+import os
+import sys
+
+import tensorflowonspark_tpu as tos
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples", "cifar10")
+if EXAMPLES not in sys.path:
+    sys.path.insert(0, EXAMPLES)
+
+import cifar10_train  # noqa: E402
+
+
+def test_cifar_model_forward_shape():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import resnet
+
+    model = resnet.build_resnet_cifar({"depth_blocks": 1, "bf16": False, "width": 8})
+    variables = resnet.init_variables(model, jax.random.PRNGKey(0), image_size=32)
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, jnp.zeros((2, 32, 32, 3), jnp.float32))
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_direct_tfrecord_cifar_train(tmp_path):
+    data_dir = str(tmp_path / "tfr")
+    cifar10_train.prepare_data(data_dir, samples=32, partitions=2)
+    # width/depth match test_cifar_model_forward_shape so the two tests share
+    # persistent-cache entries where programs coincide; 1 executor so a cold
+    # cache costs one compile, not two concurrent ones.
+    args = {"data_dir": data_dir, "export_dir": str(tmp_path / "export"),
+            "epochs": 1, "batch_size": 8, "depth_blocks": 1, "width": 8,
+            "bf16": False}
+    cluster = tos.run(cifar10_train.main_fun, args, num_executors=1,
+                      input_mode=tos.InputMode.DIRECT,
+                      log_dir=str(tmp_path / "nodelogs"), reservation_timeout=120)
+    cluster.shutdown(timeout=300)
+    assert os.path.exists(tmp_path / "export" / "bundle.json")

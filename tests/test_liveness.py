@@ -45,15 +45,18 @@ def test_coordinator_death_unblocks_node(tmp_path):
     client.feed_partition(range(10))  # node consumed a partition, now blocked
     t0 = time.monotonic()
     cluster.coordinator.stop()  # the "driver crash": no EOF, no stop signal
-    # 3 failed heartbeats at 0.3s spacing plus connect/teardown slack
-    assert cluster.launcher.join(timeout=20.0), (
+    # 3 failed heartbeats at 0.3s spacing plus connect/teardown slack; some
+    # headroom over the ~1s design point because concurrent XLA compiles can
+    # starve this process on a 1-core CI box, but tight enough that a
+    # teardown regression into tens of seconds still fails the gate
+    assert cluster.launcher.join(timeout=30.0), (
         "node did not exit after coordinator loss"
     )
     elapsed = time.monotonic() - t0
     assert [p.exitcode for p in cluster.launcher.processes] == [0]
     # the forced EndOfFeed let map_fun finish cleanly: its output exists
     assert (tmp_path / "node_0.txt").read_text().split()[1] == "10"
-    assert elapsed < 20.0
+    assert elapsed < 30.0
     for c in cluster._clients.values():
         c.close()
 

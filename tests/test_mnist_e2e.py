@@ -27,7 +27,7 @@ def test_streaming_train_then_inference(tmp_path):
 
     args = {**TINY, "model_dir": str(tmp_path / "model"), "export_dir": str(tmp_path / "export"),
             "log_dir": str(tmp_path / "logs")}
-    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(320), 4)
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(240), 4)
 
     cluster = tos.run(mnist_dist.main_fun, args, num_executors=2,
                       input_mode=tos.InputMode.STREAMING,
@@ -68,7 +68,7 @@ def test_restart_resumes_from_checkpoint(tmp_path):
     from tensorflowonspark_tpu.models.mnist import synthetic_mnist
 
     args = {**TINY, "model_dir": str(tmp_path / "model")}
-    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(64), 2)
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(40), 2)
 
     c1 = tos.run(mnist_dist.main_fun, args, num_executors=1,
                  input_mode=tos.InputMode.STREAMING,

@@ -63,13 +63,13 @@ class TestNamespace:
 
 class TestFitTransform:
     def test_fit_then_transform(self, tmp_path):
-        rows = wide_deep.synthetic_criteo(48, seed=1)
+        rows = wide_deep.synthetic_criteo(32, seed=1)
         data = PartitionedDataset.from_iterable(rows, 4)
         est = pipeline.TPUEstimator(
             mapfuns.train_wide_deep,
             {"vocab_size": 1009},
         )
-        est.setNumExecutors(2).setEpochs(2).setBatchSize(16)
+        est.setNumExecutors(2).setEpochs(1).setBatchSize(16)
         est.set("export_dir", str(tmp_path / "export"))
         est.set("log_dir", str(tmp_path / "logs"))
         model = est.fit(data)
