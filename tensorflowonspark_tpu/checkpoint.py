@@ -229,6 +229,11 @@ def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
     if any(not getattr(v, "is_fully_addressable", True)
            for v in flat_leaves.values()):
         save_checkpoint(os.path.join(export_dir, "params"), params)
+        # A re-export over a directory that previously held an npz bundle
+        # must not leave the stale npz behind — load_bundle prefers it.
+        stale = os.path.join(local, "params.npz")
+        if os.path.exists(stale):
+            os.remove(stale)
         with open(os.path.join(local, "bundle.json"), "w") as f:
             json.dump(model_config, f, indent=2, sort_keys=True)
         return local

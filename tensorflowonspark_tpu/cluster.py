@@ -248,13 +248,28 @@ class TPUCluster:
             # DIRECT-mode map_funs never consume the feed; EOF would just open
             # pointless connections to nodes that may already have exited.
             if self.input_mode == InputMode.STREAMING:
+                # executor_id is assigned in REGISTRATION order, not launch
+                # order — match processes through the pid each node reported
+                # at registration, not through launch index.
+                by_pid = {p.pid: p for p in self.launcher.processes}
+                id_to_pid = {m["executor_id"]: m.get("pid")
+                             for m in self.cluster_info}
                 for executor_id in self._feed_ids:
                     for qname in self.input_qnames:
                         try:
                             self._client(executor_id).send_eof(qname)
                         except Exception:
-                            logger.warning("could not send EOF to node %d queue %r",
-                                           executor_id, qname, exc_info=True)
+                            proc = by_pid.get(id_to_pid.get(executor_id))
+                            if proc is not None and not proc.is_alive():
+                                # Normal teardown race: the node finished its
+                                # map_fun (e.g. inference loops exit on stop)
+                                # and closed its data plane before EOF landed.
+                                logger.debug("node %d exited before EOF on %r",
+                                             executor_id, qname)
+                            else:
+                                logger.warning(
+                                    "could not send EOF to node %d queue %r",
+                                    executor_id, qname, exc_info=True)
             if grace_secs:
                 time.sleep(grace_secs)
             # Politely wait for map_funs to finish; only then escalate.  The
