@@ -57,6 +57,12 @@ def main() -> None:
                    help=">0 fuses the lm_head into a blockwise cross-entropy "
                         "(ops/xent.py) — never materializes [B,S,V] logits; "
                         "use with tp=1")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize per-block activations (jax.checkpoint)"
+                        " — O(1) residuals per block for ~1/3 extra FLOPs")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help=">1 splits each batch into microbatches and "
+                        "accumulates gradients before the optimizer update")
     p.add_argument("--profile-dir", default="",
                    help="write a jax profiler trace of the steady state here")
     args = p.parse_args()
@@ -81,7 +87,8 @@ def main() -> None:
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads,
         n_experts=args.n_experts, attn_impl=attn_impl, mesh=mesh,
-        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        remat=args.remat)
 
     ids = jnp.asarray(synthetic_ids(args.batch, args.seq_len, args.vocab_size))
     # init traces the model too, so the init batch must satisfy the same
@@ -98,7 +105,8 @@ def main() -> None:
         params = meshlib.shard_tree(mesh, params, shardings)
         state = dplib.TrainState.create(params, optimizer)
         step = dplib.make_train_step(
-            tfm.make_loss_fn(model, vocab_chunk=args.vocab_chunk), optimizer)
+            tfm.make_loss_fn(model, vocab_chunk=args.vocab_chunk), optimizer,
+            accum_steps=args.accum_steps)
         batch = meshlib.shard_batch(mesh, {"input_ids": np.asarray(ids)})
 
         state, metrics = step(state, batch)  # compile

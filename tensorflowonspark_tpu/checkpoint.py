@@ -231,9 +231,12 @@ def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
         save_checkpoint(os.path.join(export_dir, "params"), params)
         # A re-export over a directory that previously held an npz bundle
         # must not leave the stale npz behind — load_bundle prefers it.
-        stale = os.path.join(local, "params.npz")
-        if os.path.exists(stale):
-            os.remove(stale)
+        # Every process runs this branch (the sharded save is a collective);
+        # on shared storage only one unlink wins, the rest must not crash.
+        import contextlib
+
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(os.path.join(local, "params.npz"))
         with open(os.path.join(local, "bundle.json"), "w") as f:
             json.dump(model_config, f, indent=2, sort_keys=True)
         return local

@@ -249,17 +249,21 @@ class TPUCluster:
             # pointless connections to nodes that may already have exited.
             if self.input_mode == InputMode.STREAMING:
                 # executor_id is assigned in REGISTRATION order, not launch
-                # order — match processes through the pid each node reported
-                # at registration, not through launch index.
-                by_pid = {p.pid: p for p in self.launcher.processes}
-                id_to_pid = {m["executor_id"]: m.get("pid")
-                             for m in self.cluster_info}
+                # order — match processes through the launch_index each node
+                # reported at registration (pids can't do this: over ssh
+                # transports the local handle's pid is the ssh client).
+                procs = self.launcher.processes
+                id_to_proc = {
+                    m["executor_id"]: procs[m["launch_index"]]
+                    for m in self.cluster_info
+                    if 0 <= m.get("launch_index", -1) < len(procs)
+                }
                 for executor_id in self._feed_ids:
                     for qname in self.input_qnames:
                         try:
                             self._client(executor_id).send_eof(qname)
                         except Exception:
-                            proc = by_pid.get(id_to_pid.get(executor_id))
+                            proc = id_to_proc.get(executor_id)
                             if proc is not None and not proc.is_alive():
                                 # Normal teardown race: the node finished its
                                 # map_fun (e.g. inference loops exit on stop)
@@ -375,6 +379,7 @@ def run(
             tensorboard=tensorboard,
             jax_distributed=jax_distributed,
             env={**(env or {}), **(per_node_env[i] if per_node_env else {})},
+            launch_index=i,
         )
         for i in range(num_executors)
     ]

@@ -64,6 +64,11 @@ class NodeConfig:
     jax_distributed: bool = False
     heartbeat_interval: float = 2.0
     env: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Position in the launcher's process list; registered back to the
+    # coordinator so the driver can map executor_id -> process handle
+    # (pids don't work for that: over ssh transports the local handle's pid
+    # is the ssh client, not the remote node).
+    launch_index: int = -1
 
 
 class NodeContext:
@@ -245,7 +250,8 @@ def node_main(config: NodeConfig) -> int:
     device_meta = ({"platform": "pending_distributed_init"}
                    if config.jax_distributed else tpu_info.device_summary())
     ident = client.register({"host": local_ip(), "data_port": data_port,
-                             "pid": os.getpid(), "device": device_meta})
+                             "pid": os.getpid(), "device": device_meta,
+                             "launch_index": config.launch_index})
     executor_id = ident["executor_id"]
     cluster_info = client.await_cluster(timeout=config.reservation_timeout)
 

@@ -62,6 +62,7 @@ def make_train_step(
     loss_fn: Callable[[Any, Any], tuple[jax.Array, dict]],
     optimizer: optax.GradientTransformation,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
     """Build the jitted SPMD train step.
 
@@ -70,13 +71,53 @@ def make_train_step(
     inserts the gradient all-reduce over ICI automatically.  Metrics come
     back replicated scalars (already globally reduced, since the loss is a
     mean over the global batch).
+
+    ``accum_steps > 1`` enables gradient accumulation: the global batch is
+    split into that many microbatches along axis 0 and run through a
+    ``lax.scan`` (one compiled microstep body, not an unrolled loop);
+    gradients/metrics are averaged and the optimizer applies ONE update.
+    The per-call batch size must be divisible by ``accum_steps``.
+
+    Equivalence caveat: the accumulated step averages each microbatch's
+    ALREADY-NORMALIZED loss gradient.  For losses that are plain means over
+    examples this equals the full-batch step exactly; for losses with
+    data-dependent normalization (e.g. ``loss_mask`` token averaging, where
+    each microbatch divides by its own mask count) the weighting differs —
+    microbatches with few unmasked tokens count more per token.  For masked
+    LM training either keep mask density uniform across microbatches or use
+    ``accum_steps=1``.
     """
 
     def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
+        if accum_steps == 1:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+            metrics = {"loss": loss, **aux}
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
+                                    *x.shape[1:]), batch)
+
+            def body(carry, mb):
+                grads_acc, metrics_acc = carry
+                (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, mb)
+                m = {"loss": l, **aux}
+                return (jax.tree.map(jnp.add, grads_acc, g),
+                        jax.tree.map(jnp.add, metrics_acc, m)), None
+
+            # Carry structure from an abstract eval — loss_fn is traced once
+            # (inside the scan body), not twice.
+            loss_sd, aux_sd = jax.eval_shape(
+                loss_fn, state.params, jax.tree.map(lambda x: x[0], micro))
+            zeros = lambda sd: jnp.zeros(sd.shape, sd.dtype)  # noqa: E731
+            init = (jax.tree.map(jnp.zeros_like, state.params),
+                    jax.tree.map(zeros, {"loss": loss_sd, **aux_sd}))
+            (grads, msum), _ = jax.lax.scan(body, init, micro)
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            metrics = jax.tree.map(lambda m: m / accum_steps, msum)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, **aux}
         return TrainState(params, opt_state, state.step + 1), metrics
 
     # Shardings are inferred from operand placement (replicated params +
