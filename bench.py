@@ -280,9 +280,17 @@ def _zero_json(error: str) -> dict:
 
 
 def _probe_backend() -> tuple[bool, str]:
-    """Backend init in a subprocess with a hard timeout; retried once."""
+    """Backend init in a subprocess with a hard timeout; retried with a
+    pause.  The pause matters: an abandoned chip claim (e.g. a client killed
+    mid-remote-compile) can wedge backend init for a while and then clear —
+    back-to-back retries would both land inside the wedge window."""
     last = ""
-    for attempt in (1, 2):
+    timed_out = False
+    for attempt in (1, 2, 3):
+        if attempt > 1 and timed_out:
+            # only a hung init suggests a recoverable wedge; hard failures
+            # (no accelerator, import error) should fail the gate fast
+            time.sleep(120)
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
@@ -296,8 +304,10 @@ def _probe_backend() -> tuple[bool, str]:
                       file=sys.stderr)
                 return True, ok_line
             last = f"rc={proc.returncode} tail={' | '.join(out[-3:])}"
+            timed_out = False
         except subprocess.TimeoutExpired:
             last = f"backend init timed out after {PROBE_TIMEOUT_S}s"
+            timed_out = True
         print(f"bench probe attempt {attempt} failed: {last}", file=sys.stderr)
     return False, last
 

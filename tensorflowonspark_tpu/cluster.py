@@ -103,12 +103,17 @@ class TPUCluster:
 
     # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------
 
-    def train(self, data: Any, num_epochs: int = 1, qname: str = "input") -> None:
+    def train(self, data: Any, num_epochs: int = 1, qname: str = "input",
+              shuffle_seed: int | None = None) -> None:
         """Stream partitions into the worker feeds (InputMode.STREAMING only).
 
         Partition *i* goes to feedable node ``i % W`` — the same round-robin
         partition placement Spark gave the reference.  Blocks until all
         partitions are consumed (or nodes report 'terminating').
+
+        ``shuffle_seed`` reorders partitions differently each epoch
+        (seed+epoch, deterministic) — the between-epochs shuffle the
+        reference inherited from Spark/tf.data file shuffling.
         """
         if self.input_mode != InputMode.STREAMING:
             raise RuntimeError("train(data) requires InputMode.STREAMING (reference: InputMode.SPARK)")
@@ -119,8 +124,10 @@ class TPUCluster:
             try:
                 client = self._client(executor_id)
                 for epoch in range(num_epochs):
+                    epoch_data = (dataset if shuffle_seed is None
+                                  else dataset.shuffle_partitions(shuffle_seed + epoch))
                     for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
-                        state = client.feed_partition(dataset.iter_partition(p), qname)
+                        state = client.feed_partition(epoch_data.iter_partition(p), qname)
                         if state == "terminating":
                             logger.info("node %d terminating; dropping remaining feed", executor_id)
                             return

@@ -71,6 +71,46 @@ class PartitionedDataset:
     def repartition(self, num_partitions: int) -> "PartitionedDataset":
         return PartitionedDataset.from_iterable(list(self), num_partitions)
 
+    def shuffle_partitions(self, seed: int) -> "PartitionedDataset":
+        """Deterministically reorder partitions (lazy; contents untouched).
+
+        The between-epochs shuffle the reference got from Spark/tf.data file
+        shuffling: pass a per-epoch seed so every epoch streams partitions
+        in a different order without materializing anything.
+        """
+        import random
+
+        order = list(range(self.num_partitions))
+        random.Random(seed).shuffle(order)
+        return PartitionedDataset([self._partition_fns[i] for i in order])
+
+
+def shuffle_buffer(items: Iterable[Any], buffer_size: int,
+                   seed: int) -> Iterator[Any]:
+    """Streaming buffered shuffle — the ``tf.data.Dataset.shuffle`` analogue.
+
+    Fills a ``buffer_size`` reservoir, then yields a uniformly random buffer
+    slot per incoming item (replacing it), draining the rest at the end.
+    O(buffer_size) memory, deterministic under ``seed``, emits every input
+    exactly once.  Perfect shuffling needs ``buffer_size >= len(items)``;
+    smaller buffers trade randomness for memory exactly like tf.data.
+    """
+    import random
+
+    if buffer_size < 1:
+        raise ValueError("buffer_size must be >= 1")
+    rng = random.Random(seed)
+    buf: list[Any] = []
+    for item in items:
+        if len(buf) < buffer_size:
+            buf.append(item)
+            continue
+        idx = rng.randrange(buffer_size)
+        yield buf[idx]
+        buf[idx] = item
+    rng.shuffle(buf)
+    yield from buf
+
 
 def as_partitioned(data: Any, default_partitions: int = 1) -> PartitionedDataset:
     """Coerce user input into a PartitionedDataset.

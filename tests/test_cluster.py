@@ -44,7 +44,10 @@ def test_train_streaming_sums(tmp_path):
         reservation_timeout=60,
     )
     data = tos.PartitionedDataset.from_iterable(range(100), 4)
-    cluster.train(data, num_epochs=2)
+    # shuffle_seed reorders partitions per epoch; exactly-once delivery and
+    # the global sum are order-invariant, so the invariants below also pin
+    # the shuffled path
+    cluster.train(data, num_epochs=2, shuffle_seed=13)
     cluster.shutdown()
     totals, counts = 0.0, 0
     for i in range(2):

@@ -3,6 +3,7 @@ per-block rematerialization (Transformer(remat=True)): both must be
 numerically transparent — same params/update trajectory as the plain path."""
 
 import jax
+import jax.flatten_util  # noqa: F401 - registers jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -1,0 +1,37 @@
+"""Shuffling utilities (data.py): partition reorder + streaming buffer
+shuffle — deterministic under seed, every element exactly once."""
+
+from collections import Counter
+
+from tensorflowonspark_tpu.data import PartitionedDataset, shuffle_buffer
+
+
+def test_shuffle_partitions_is_permutation_and_deterministic():
+    ds = PartitionedDataset.from_partitions([[1, 2], [3, 4], [5], [6, 7, 8]])
+    s1 = ds.shuffle_partitions(seed=7)
+    s2 = ds.shuffle_partitions(seed=7)
+    s3 = ds.shuffle_partitions(seed=8)
+    assert list(s1) == list(s2)                      # deterministic
+    assert sorted(s1) == sorted(ds)                  # permutation of elements
+    assert s1.num_partitions == ds.num_partitions
+    # partitions move as units
+    flat = list(s1)
+    assert [6, 7, 8] == flat[flat.index(6) : flat.index(6) + 3]
+    assert list(s3) != list(s1) or list(s3) != list(ds)  # seed matters
+
+
+def test_shuffle_buffer_exactly_once_and_deterministic():
+    items = list(range(100))
+    out1 = list(shuffle_buffer(items, buffer_size=16, seed=3))
+    out2 = list(shuffle_buffer(items, buffer_size=16, seed=3))
+    assert out1 == out2
+    assert Counter(out1) == Counter(items)           # exactly once
+    assert out1 != items                             # actually shuffled
+
+
+def test_shuffle_buffer_small_input_and_full_buffer():
+    # input smaller than buffer: pure Fisher-Yates of everything
+    out = list(shuffle_buffer([1, 2, 3], buffer_size=10, seed=0))
+    assert Counter(out) == Counter([1, 2, 3])
+    # buffer_size 1 degenerates to identity order
+    assert list(shuffle_buffer(list(range(10)), buffer_size=1, seed=0)) == list(range(10))
