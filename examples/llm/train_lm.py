@@ -63,6 +63,9 @@ def main() -> None:
     p.add_argument("--accum-steps", type=int, default=1,
                    help=">1 splits each batch into microbatches and "
                         "accumulates gradients before the optimizer update")
+    p.add_argument("--generate", type=int, default=0,
+                   help=">0 greedily decodes this many tokens after training "
+                        "(KV-cache serving loop)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax profiler trace of the steady state here")
     args = p.parse_args()
@@ -132,6 +135,16 @@ def main() -> None:
         tokens = args.batch * args.seq_len * args.steps
         print(f"step {args.steps}: loss={loss:.4f} "
               f"({tokens / dt:,.0f} tokens/sec)")
+        params_host = jax.device_get(state.params) if args.generate else None
+
+    if args.generate:
+        # Outside the mesh context: decode is a batch-1 single-device loop,
+        # and the model's activation-sharding hints no-op without a mesh.
+        prompt = np.asarray(ids[:1, :8])
+        out = tfm.greedy_generate(model.clone(mesh=None, attn_impl="xla"),
+                                  params_host, jnp.asarray(prompt),
+                                  max_new_tokens=args.generate)
+        print(f"generated: {out[0].tolist()}")
 
 
 if __name__ == "__main__":

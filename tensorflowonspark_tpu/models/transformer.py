@@ -252,6 +252,49 @@ def build_transformer(config: dict) -> Transformer:
     )
 
 
+def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
+                    max_decode_len: int = 0):
+    """Autoregressive greedy decoding through the static KV cache.
+
+    ``prompt_ids: [B, S] int32`` → ``[B, S + max_new_tokens]``.  The decode
+    model processes ONE token per step against a ``[B, L, H, D]`` cache with
+    static shapes (``Attention._decode_step``), so the whole loop reuses a
+    single compiled program — the TPU-idiomatic serving loop.  No reference
+    counterpart (its models are CNNs); this exists because the LM family is
+    first-class here.
+    """
+    import numpy as np
+
+    b, s = prompt_ids.shape
+    L = max_decode_len or (s + max_new_tokens)
+    if L < s + max_new_tokens:
+        raise ValueError(f"max_decode_len {L} < prompt {s} + new {max_new_tokens}")
+    dmodel = model.clone(decode=True, max_decode_len=L, return_hidden=False)
+    # flax init RUNS the decode step, so the returned cache already holds the
+    # dummy token with index=1 — zero it to get a genuinely empty cache.
+    cache = jax.tree.map(jnp.zeros_like, dmodel.init(
+        jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32))["cache"])
+
+    @jax.jit
+    def step(params, cache, tok):
+        # params is an ARGUMENT, not a closure capture: captured arrays
+        # would be baked into the executable as constants (a second copy
+        # of the weights in HBM for the serving loop).
+        logits, mutated = dmodel.apply({"params": params, "cache": cache},
+                                       tok, mutable=["cache"])
+        return mutated["cache"], logits[:, -1]
+
+    tokens = [np.asarray(prompt_ids[:, i]) for i in range(s)]
+    logits = None
+    for i in range(s):  # prefill one token at a time (same compiled step)
+        cache, logits = step(params, cache, prompt_ids[:, i : i + 1])
+    for _ in range(max_new_tokens):
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tokens.append(np.asarray(nxt))
+        cache, logits = step(params, cache, nxt[:, None])
+    return np.stack(tokens, axis=1)
+
+
 def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01,
                  vocab_chunk: int = 0):
     """Next-token LM loss.  Batch: ``{"input_ids": [B, S] int32}`` (targets

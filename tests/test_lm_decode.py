@@ -1,0 +1,55 @@
+"""Autoregressive decode path (KV cache) parity: cached single-token logits
+must match the full-context forward at every position, and greedy_generate
+must continue exactly like teacher-forced argmax."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.models import transformer as tfm
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tfm.Transformer(vocab_size=29, d_model=16, n_layers=2, n_heads=2,
+                            attn_impl="xla", compute_dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 29, (2, 10)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    return model, ids, params
+
+
+def test_decode_cache_matches_full_forward(lm):
+    model, ids, params = lm
+    full = jax.jit(lambda p, x: model.apply({"params": p}, x))(params, ids)
+
+    L = ids.shape[1]
+    dmodel = model.clone(decode=True, max_decode_len=L)
+    # zero the cache: flax init runs the decode step on the dummy token
+    cache = jax.tree.map(jnp.zeros_like, dmodel.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))["cache"])
+    step = jax.jit(lambda c, t: dmodel.apply(
+        {"params": params, "cache": c}, t, mutable=["cache"]))
+    for i in range(L):
+        logits, mutated = step(cache, ids[:, i : i + 1])
+        cache = mutated["cache"]
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_generate_matches_teacher_forcing(lm):
+    model, ids, params = lm
+    prompt = ids[:, :4]
+    out = tfm.greedy_generate(model, params, prompt, max_new_tokens=5)
+    assert out.shape == (2, 9)
+    np.testing.assert_array_equal(out[:, :4], np.asarray(prompt))
+
+    # replaying the generated prefix through the full model must predict the
+    # same next token at each generated position (greedy = argmax chain)
+    for t in range(4, 9 - 1):
+        full = jax.jit(lambda p, x: model.apply({"params": p}, x))(
+            params, jnp.asarray(out[:, : t]))
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(full[:, -1], axis=-1)), out[:, t])
