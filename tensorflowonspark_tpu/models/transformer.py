@@ -253,8 +253,9 @@ def build_transformer(config: dict) -> Transformer:
 
 
 def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
-                    max_decode_len: int = 0):
-    """Autoregressive greedy decoding through the static KV cache.
+                    max_decode_len: int = 0, temperature: float = 0.0,
+                    top_k: int = 0, seed: int = 0):
+    """Autoregressive decoding through the static KV cache.
 
     ``prompt_ids: [B, S] int32`` → ``[B, S + max_new_tokens]``.  The decode
     model processes ONE token per step against a ``[B, L, H, D]`` cache with
@@ -262,6 +263,10 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
     single compiled program — the TPU-idiomatic serving loop.  No reference
     counterpart (its models are CNNs); this exists because the LM family is
     first-class here.
+
+    ``temperature == 0`` (default) is greedy argmax; ``> 0`` samples from
+    ``softmax(logits / temperature)``, optionally truncated to the
+    ``top_k`` most likely tokens.  Sampling is deterministic under ``seed``.
     """
     import numpy as np
 
@@ -284,12 +289,24 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
                                        tok, mutable=["cache"])
         return mutated["cache"], logits[:, -1]
 
+    @jax.jit
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    key = jax.random.PRNGKey(seed)
     tokens = [np.asarray(prompt_ids[:, i]) for i in range(s)]
     logits = None
     for i in range(s):  # prefill one token at a time (same compiled step)
         cache, logits = step(params, cache, prompt_ids[:, i : i + 1])
     for _ in range(max_new_tokens):
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        nxt = pick(logits, sub)
         tokens.append(np.asarray(nxt))
         cache, logits = step(params, cache, nxt[:, None])
     return np.stack(tokens, axis=1)

@@ -172,6 +172,11 @@ class HasFeedTimeout(Params):
     feed_timeout = Param("feed_timeout", 600.0, "seconds before a stalled feed errors")
 
 
+class HasShuffleSeed(Params):
+    shuffle_seed = Param("shuffle_seed", None,
+                         "per-epoch partition shuffle seed (STREAMING mode)")
+
+
 class HasReservationTimeout(Params):
     reservation_timeout = Param("reservation_timeout", 120.0,
                                 "seconds to wait for all nodes to register")
@@ -224,7 +229,8 @@ class Namespace:
 class TPUParams(HasBatchSize, HasEpochs, HasSteps, HasInputMapping,
                 HasOutputMapping, HasInputMode, HasMasterNode, HasNumExecutors,
                 HasModelDir, HasExportDir, HasTFRecordDir, HasTensorboard,
-                HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout):
+                HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout,
+                HasShuffleSeed):
     """All framework params in one mixin stack (reference ``TFParams``)."""
 
     def merge_args_params(self, tf_args: Any = None) -> Namespace:
@@ -284,7 +290,8 @@ class TPUEstimator(TPUParams):
         )
         try:
             if input_mode == InputMode.STREAMING:
-                cluster.train(data, num_epochs=args.epochs)
+                cluster.train(data, num_epochs=args.epochs,
+                              shuffle_seed=args.shuffle_seed)
         finally:
             cluster.shutdown()
         model = TPUModel(tf_args=args)

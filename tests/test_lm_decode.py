@@ -48,8 +48,20 @@ def test_greedy_generate_matches_teacher_forcing(lm):
 
     # replaying the generated prefix through the full model must predict the
     # same next token at each generated position (greedy = argmax chain)
-    for t in range(4, 9 - 1):
+    for t in range(4, 9):
         full = jax.jit(lambda p, x: model.apply({"params": p}, x))(
             params, jnp.asarray(out[:, : t]))
         np.testing.assert_array_equal(
             np.asarray(jnp.argmax(full[:, -1], axis=-1)), out[:, t])
+
+
+def test_sampled_generation_valid_and_deterministic(lm):
+    model, ids, params = lm
+    prompt = ids[:, :3]
+    a = tfm.greedy_generate(model, params, prompt, max_new_tokens=6,
+                            temperature=0.8, top_k=5, seed=11)
+    b = tfm.greedy_generate(model, params, prompt, max_new_tokens=6,
+                            temperature=0.8, top_k=5, seed=11)
+    np.testing.assert_array_equal(a, b)              # deterministic per seed
+    assert a.shape == (2, 9)
+    assert ((a >= 0) & (a < 29)).all()               # valid token ids
