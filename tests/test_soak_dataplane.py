@@ -1,0 +1,44 @@
+"""Bounded soak of the data plane: many small partitions through feed and
+inference round-trips — shakes ring/TCP framing, EndPartition bookkeeping,
+and the ordered exactly-count invariant at a partition count well above what
+the e2e tests use (reference regime: hundreds of Spark partitions)."""
+
+import tensorflowonspark_tpu as tos
+from tensorflowonspark_tpu.cluster import InputMode
+
+import mapfuns
+
+
+def test_many_partition_train_and_inference(tmp_path):
+    # 60 uneven partitions (sizes 0..~12) x 2 epochs through 2 nodes
+    items = list(range(300))
+    parts, i = [], 0
+    size = 0
+    while i < len(items):
+        parts.append(items[i : i + size])
+        i += size
+        size = (size + 1) % 13
+    parts.append(items[i:])
+    data = tos.PartitionedDataset.from_partitions(parts)
+    assert data.num_partitions >= 40
+
+    cluster = tos.run(mapfuns.sum_batches, {"out_dir": str(tmp_path), "batch_size": 7},
+                      num_executors=2, input_mode=InputMode.STREAMING,
+                      reservation_timeout=60)
+    cluster.train(data, num_epochs=2, shuffle_seed=5)
+    cluster.shutdown()
+    totals = counts = 0
+    for i in range(2):
+        t, c = (tmp_path / f"node_{i}.txt").read_text().split()
+        totals += float(t)
+        counts += int(c)
+    assert counts == 600
+    assert totals == 2 * sum(items)
+
+    # inference: 47 uneven partitions, ordered exactly-count
+    c2 = tos.run(mapfuns.echo_inference, {}, num_executors=2,
+                 input_mode=InputMode.STREAMING, reservation_timeout=60)
+    vals = list(range(211))
+    preds = c2.inference(tos.PartitionedDataset.from_iterable(vals, 47))
+    c2.shutdown()
+    assert preds == [v * 2 for v in vals]
