@@ -252,6 +252,31 @@ def build_transformer(config: dict) -> Transformer:
     )
 
 
+def pad_batch(token_lists, seq_len: int, pad_id: int = 0):
+    """Ragged token lists → ``{"input_ids": [B,S], "loss_mask": [B,S]}``.
+
+    The mask marks REAL tokens; ``make_loss_fn`` averages the next-token
+    loss over real target positions only, so padding contributes nothing to
+    the LM loss (causal attention keeps real positions blind to right-pads).
+    Sequences longer than ``seq_len`` are truncated.
+
+    MoE caveat: the expert router (``parallel/ep.py``) runs over ALL
+    positions — pad tokens still occupy capacity slots and enter the
+    load-balance aux statistics.  For ``n_experts > 0`` training prefer
+    packing sequences back-to-back over padding ragged ones.
+    """
+    import numpy as np
+
+    b = len(token_lists)
+    ids = np.full((b, seq_len), pad_id, np.int32)
+    mask = np.zeros((b, seq_len), np.float32)
+    for i, toks in enumerate(token_lists):
+        n = min(len(toks), seq_len)
+        ids[i, :n] = np.asarray(toks[:n], np.int32)
+        mask[i, :n] = 1.0
+    return {"input_ids": ids, "loss_mask": mask}
+
+
 def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
                     max_decode_len: int = 0, temperature: float = 0.0,
                     top_k: int = 0, seed: int = 0):

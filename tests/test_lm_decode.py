@@ -86,6 +86,24 @@ def test_moe_decode_cache_matches_full_forward():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_pad_batch_masks_padding_out_of_loss(lm):
+    model, _, params = lm
+    batch = tfm.pad_batch([[1, 2, 3, 4, 5, 6], [7, 8]], seq_len=6)
+    assert batch["input_ids"].shape == (2, 6)
+    np.testing.assert_array_equal(batch["loss_mask"][1], [1, 1, 0, 0, 0, 0])
+    loss_fn = tfm.make_loss_fn(model)
+    masked, _ = jax.jit(loss_fn)(params, batch)
+    # garbage in the padded region must not change the masked loss
+    poisoned = dict(batch)
+    poisoned["input_ids"] = batch["input_ids"].copy()
+    poisoned["input_ids"][1, 3:] = 9
+    repoisoned, _ = jax.jit(loss_fn)(params, poisoned)
+    # position 2's next-token target (position 3) IS affected by the edit;
+    # mask[:,1:] covers targets 1..5 where mask row1 = [1,0,0,0,0] -> only
+    # target at position 1 counts, unaffected by edits at >=3
+    np.testing.assert_allclose(float(repoisoned), float(masked), rtol=1e-6)
+
+
 def test_sampled_generation_valid_and_deterministic(lm):
     model, ids, params = lm
     prompt = ids[:, :3]
