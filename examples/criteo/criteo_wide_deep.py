@@ -50,7 +50,7 @@ def train_fn(args, ctx):
     feed = ctx.get_data_feed(train_mode=True)
     batches = dplib.make_batch_iterator(
         feed, int(args.get("batch_size", 512)), wide_deep.batch_to_arrays,
-        mesh=mesh, ctx=ctx)
+        mesh=mesh, ctx=ctx, max_steps=args.get("steps"))
     step = loss = None
     for batch, _n in batches:
         state, metrics = step_fn(state, batch)

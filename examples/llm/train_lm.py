@@ -140,9 +140,13 @@ def main() -> None:
     if args.generate:
         # Outside the mesh context: decode is a batch-1 single-device loop,
         # and the model's activation-sharding hints no-op without a mesh.
+        # Re-place the host snapshot once: handing numpy params to the jitted
+        # decode step would re-transfer the full weight tree host->device on
+        # EVERY generated token.
+        decode_params = jax.device_put(params_host, jax.devices()[0])
         prompt = np.asarray(ids[:1, :8])
         out = tfm.greedy_generate(model.clone(mesh=None, attn_impl="xla"),
-                                  params_host, jnp.asarray(prompt),
+                                  decode_params, jnp.asarray(prompt),
                                   max_new_tokens=args.generate)
         print(f"generated: {out[0].tolist()}")
 

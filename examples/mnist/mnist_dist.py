@@ -59,7 +59,8 @@ def main_fun(args, ctx):
     last_metrics = {}
     ckpt_every = int(args.get("checkpoint_every", 0) or 0)
     for batch, _n in make_batch_iterator(
-        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx
+        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx,
+        max_steps=args.get("steps"),
     ):
         state, metrics = step(state, batch)
         step_no = int(state.step)

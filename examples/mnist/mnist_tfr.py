@@ -59,16 +59,27 @@ def main_fun(args, ctx):
     # tf.data auto-shard analogue the reference relied on).
     my_shards = dfutil.shard_files(args["data_dir"])[ctx.executor_id :: ctx.num_data_nodes]
     schema = dfutil.read_schema(args["data_dir"])
+    readers = int(args.get("readers", 1) or 1)
+
+    def shard_reader(shard):
+        def it():
+            for row in dfutil.read_shard(shard, schema):
+                yield (np.asarray(row["image"], np.float32).reshape(28, 28, 1),
+                       int(row["label"]))
+        return it
 
     def samples():
+        # `readers` Param: background reader threads overlap shard IO/decode
+        # with the train step (tf.data parallel-interleave analogue).
+        from tensorflowonspark_tpu.data import interleave
+
         for _epoch in range(args.get("epochs", 1)):
-            for shard in my_shards:
-                for row in dfutil.read_shard(shard, schema):
-                    yield (np.asarray(row["image"], np.float32).reshape(28, 28, 1), int(row["label"]))
+            yield from interleave([shard_reader(s) for s in my_shards], readers)
 
     feed = IteratorFeed(samples())
     for batch, _n in make_batch_iterator(
-        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx
+        feed, args.get("batch_size", 64), mnist.batch_to_arrays, mesh, ctx,
+        max_steps=args.get("steps"),
     ):
         state, metrics = step(state, batch)
 

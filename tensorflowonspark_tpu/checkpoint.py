@@ -241,12 +241,18 @@ def export_bundle(export_dir: str, params: Any, model_config: dict) -> str:
             json.dump(model_config, f, indent=2, sort_keys=True)
         return local
     flat = {k: np.asarray(v) for k, v in flat_leaves.items()}
+    # npz writes ml_dtypes arrays (bfloat16/float8 — numpy kind 'V') as raw
+    # void bytes and np.load hands back unusable '|V2' arrays; record their
+    # dtype names so load_bundle can .view() the bytes back.  Keys ride in
+    # bundle.json under a reserved field (the npz itself stays pure arrays).
+    extended = {k: a.dtype.name for k, a in flat.items() if a.dtype.kind == "V"}
     tmp = os.path.join(local, "params.npz.tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **flat)
     os.replace(tmp, os.path.join(local, "params.npz"))
     with open(os.path.join(local, "bundle.json"), "w") as f:
-        json.dump(model_config, f, indent=2, sort_keys=True)
+        json.dump({**model_config, "_param_dtypes": extended} if extended
+                  else model_config, f, indent=2, sort_keys=True)
     return local
 
 
@@ -257,10 +263,18 @@ def load_bundle(export_dir: str) -> tuple[Any, dict]:
     local = resolve_uri(export_dir)
     with open(os.path.join(local, "bundle.json")) as f:
         config = json.load(f)
+    extended = config.pop("_param_dtypes", {})
     npz = os.path.join(local, "params.npz")
     if os.path.exists(npz):
         with np.load(npz) as data:
-            params = _unflatten_tree({k: data[k] for k in data.files})
+            flat = {k: data[k] for k in data.files}
+        if extended:
+            import ml_dtypes
+
+            flat = {k: (v.view(np.dtype(getattr(ml_dtypes, extended[k])))
+                        if k in extended else v)
+                    for k, v in flat.items()}
+        params = _unflatten_tree(flat)
     else:  # bundles written before the npz format: orbax layout
         params = restore_checkpoint(os.path.join(export_dir, "params"))
     return params, config

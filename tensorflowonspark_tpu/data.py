@@ -112,6 +112,90 @@ def shuffle_buffer(items: Iterable[Any], buffer_size: int,
     yield from buf
 
 
+def interleave(factories: Sequence[Callable[[], Iterator[Any]]],
+               num_readers: int = 2, buffer_size: int = 256) -> Iterator[Any]:
+    """Read several sources with background reader threads — the
+    ``tf.data.Dataset.interleave(..., num_parallel_calls=N)`` analogue and
+    the consumer of the pipeline layer's ``readers`` Param (reference:
+    per-node reader threads in DIRECT/TENSORFLOW input mode).
+
+    ``factories`` are zero-arg callables returning fresh iterators (e.g.
+    per-TFRecord-shard readers).  ``num_readers`` threads each pull whole
+    sources off a shared work queue and push items into one bounded buffer;
+    IO/decode of shard N+1 overlaps the consumer's compute on shard N.
+    Cross-source item order is nondeterministic (like tf.data's parallel
+    interleave); within one source, order is preserved.  Reader exceptions
+    re-raise at the consumer.  With ``num_readers <= 1`` reads happen inline
+    (deterministic order, zero threads).
+    """
+    import queue as _queue
+    import threading
+
+    if num_readers <= 1:
+        for f in factories:
+            yield from f()
+        return
+
+    work: _queue.Queue = _queue.Queue()
+    for f in factories:
+        work.put(f)
+    out: _queue.Queue = _queue.Queue(maxsize=buffer_size)
+    stop = threading.Event()
+    DONE = object()
+    failure: list[BaseException] = []
+
+    def _reader() -> None:
+        try:
+            while not stop.is_set():
+                try:
+                    factory = work.get_nowait()
+                except _queue.Empty:
+                    return
+                for item in factory():
+                    while not stop.is_set():
+                        try:
+                            out.put(item, timeout=0.1)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+        except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+            failure.append(e)
+        finally:
+            # bounded put: if the consumer abandoned the generator nobody
+            # drains the buffer, and a blocking put would strand this thread
+            while True:
+                try:
+                    out.put(DONE, timeout=0.1)
+                    break
+                except _queue.Full:
+                    if stop.is_set():
+                        break
+
+    n = min(num_readers, len(factories)) or 1
+    threads = [threading.Thread(target=_reader, name=f"interleave-{i}",
+                                daemon=True) for i in range(n)]
+    for t in threads:
+        t.start()
+    done = 0
+    try:
+        while done < n:
+            if failure:  # surface a reader crash NOW, not after the other
+                raise failure[0]  # readers drain their (possibly huge) shards
+            item = out.get()
+            if item is DONE:
+                done += 1
+                continue
+            yield item
+        if failure:
+            raise failure[0]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+
 def as_partitioned(data: Any, default_partitions: int = 1) -> PartitionedDataset:
     """Coerce user input into a PartitionedDataset.
 

@@ -71,6 +71,14 @@ class LocalLauncher:
         self._procs: list[mp.Process] = []
 
     def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
+        # Re-launchable: a fresh cluster must not inherit handles of a
+        # previous run (launch_index -> process mapping relies on positions
+        # matching THIS launch's configs).  Leftovers still alive — e.g. a
+        # prior run that raised before shutdown — are terminated, not
+        # silently orphaned holding ports/accelerators.
+        if any(p.is_alive() for p in self._procs):
+            self.terminate()
+        self._procs = []
         ctx = mp.get_context("spawn")
         for i, config in enumerate(configs):
             config.env = {**self.env, **config.env}
@@ -179,6 +187,9 @@ class SubprocessLauncher:
         self._procs: list[PopenHandle] = []
 
     def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
+        if any(p.is_alive() for p in self._procs):
+            self.terminate()  # re-launchable (see LocalLauncher.launch)
+        self._procs = []
         for i, config in enumerate(configs):
             config.env = {**self.env, **config.env}
             child_env = {**os.environ, **_pythonpath_env(), **config.env}
@@ -325,6 +336,9 @@ class TPUPodLauncher:
         if len(configs) != len(self.hosts):
             raise ValueError(
                 f"pod launcher got {len(configs)} configs for {len(self.hosts)} hosts")
+        if any(p.is_alive() for p in self._procs):
+            self.terminate()  # re-launchable (see LocalLauncher.launch)
+        self._procs = []
         for i, (host, config) in enumerate(zip(self.hosts, configs)):
             config.jax_distributed = True  # a pod IS a jax.distributed job
             config.env = {**self.host_env(i), **config.env}

@@ -102,16 +102,23 @@ class Attention(nn.Module):
         return out
 
     def _decode_step(self, x, q, k, v):
-        """One token through a static-size KV cache (``cache`` collection).
+        """``s`` tokens through a static-size KV cache (``cache`` collection).
 
-        Static shapes throughout — the cache is ``[B, max_decode_len, H, D]``
-        and masking does the rest, so the whole decode loop jits once.
+        Handles BOTH serving phases with one code path and static shapes
+        (the cache is ``[B, max_decode_len, H, D]``; masking does the rest):
+
+        - **prefill** (``s == prompt_len``): the whole prompt runs in ONE
+          forward, writing cache slots ``[cur, cur+s)`` — queries attend
+          causally within the slab and to everything before it;
+        - **decode** (``s == 1``): the classic single-token step.
+
+        So a serving loop issues O(1) compiled calls for the prompt (one
+        prefill shape + one decode shape) instead of O(prompt_len) — the
+        standard prefill/decode split of TPU serving stacks.
         """
         if self.max_decode_len <= 0:
             raise ValueError("decode mode needs max_decode_len > 0")
         b, s, h, dh = q.shape
-        if s != 1:
-            raise ValueError(f"decode mode is single-token (got seq {s})")
         L = self.max_decode_len
         ck = self.variable("cache", "k", jnp.zeros, (b, L, h, dh),
                            self.compute_dtype)
@@ -120,16 +127,18 @@ class Attention(nn.Module):
         idx = self.variable("cache", "index",
                             lambda: jnp.zeros((), jnp.int32))
         cur = idx.value
-        pos = cur[None]  # RoPE position of this token
+        pos = cur + jnp.arange(s)  # RoPE positions of this slab
         q = apply_rope(q, pos, self.rope_theta)
         k = apply_rope(k, pos, self.rope_theta)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        idx.value = cur + 1
+        idx.value = cur + s
         logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                             ck.value.astype(jnp.float32))
         logits = logits / math.sqrt(dh)
-        mask = jnp.arange(L)[None, None, None, :] <= cur
+        # query at slab offset i sees cache positions <= cur + i
+        mask = (jnp.arange(L)[None, None, None, :]
+                <= cur + jnp.arange(s)[None, None, :, None])
         logits = jnp.where(mask, logits, -1e30)
         weights = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", weights,
@@ -282,12 +291,12 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
                     top_k: int = 0, seed: int = 0):
     """Autoregressive decoding through the static KV cache.
 
-    ``prompt_ids: [B, S] int32`` → ``[B, S + max_new_tokens]``.  The decode
-    model processes ONE token per step against a ``[B, L, H, D]`` cache with
-    static shapes (``Attention._decode_step``), so the whole loop reuses a
-    single compiled program — the TPU-idiomatic serving loop.  No reference
-    counterpart (its models are CNNs); this exists because the LM family is
-    first-class here.
+    ``prompt_ids: [B, S] int32`` → ``[B, S + max_new_tokens]``.  Serving
+    runs in the standard two phases against a static ``[B, L, H, D]`` cache
+    (``Attention._decode_step``): one chunked PREFILL forward over the whole
+    prompt, then ONE-token decode steps — two compiled programs total,
+    regardless of prompt length.  No reference counterpart (its models are
+    CNNs); this exists because the LM family is first-class here.
 
     ``temperature == 0`` (default) is greedy argmax; ``> 0`` samples from
     ``softmax(logits / temperature)``, optionally truncated to the
@@ -326,9 +335,11 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
 
     key = jax.random.PRNGKey(seed)
     tokens = [np.asarray(prompt_ids[:, i]) for i in range(s)]
-    logits = None
-    for i in range(s):  # prefill one token at a time (same compiled step)
-        cache, logits = step(params, cache, prompt_ids[:, i : i + 1])
+    # Chunked prefill: ONE forward over the whole prompt populates the KV
+    # cache and yields the last position's logits — O(1) compiled calls
+    # (one [B,S] prefill program + one [B,1] decode program) instead of the
+    # O(S) sequential single-token steps of the naive loop.
+    cache, logits = step(params, cache, jnp.asarray(prompt_ids, jnp.int32))
     for _ in range(max_new_tokens):
         key, sub = jax.random.split(key)
         nxt = pick(logits, sub)
@@ -338,10 +349,12 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
 
 
 def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01,
-                 vocab_chunk: int = 0):
+                 vocab_chunk: int = 0, router_z_coef: float = 1e-3):
     """Next-token LM loss.  Batch: ``{"input_ids": [B, S] int32}`` (targets
     are inputs shifted left; final position predicts a discarded token).
-    MoE load-balance aux losses are collected from the ``aux_loss`` sow.
+    MoE auxiliary losses are collected from the ``aux_loss`` sow:
+    ``load_balance`` leaves weighted by ``aux_loss_coef`` and ``router_z``
+    leaves (ST-MoE z-loss) by ``router_z_coef``.
 
     ``vocab_chunk > 0`` fuses the lm_head matmul into a blockwise
     cross-entropy (``ops/xent.py``): the ``[B, S, V]`` logits are never
@@ -355,9 +368,16 @@ def make_loss_fn(model: Transformer, aux_loss_coef: float = 0.01,
             loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         else:
             loss = jnp.mean(nll)
-        aux = sum(jax.tree.leaves(updates.get("aux_loss", {})), 0.0)
-        total = loss + aux_loss_coef * aux
-        return total, {"lm_loss": loss, "aux_loss": jnp.asarray(aux)}
+        aux = jnp.asarray(0.0)
+        z = jnp.asarray(0.0)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                updates.get("aux_loss", {}))[0]:
+            if any("router_z" in str(p) for p in path):
+                z = z + leaf
+            else:
+                aux = aux + leaf
+        total = loss + aux_loss_coef * aux + router_z_coef * z
+        return total, {"lm_loss": loss, "aux_loss": aux, "router_z_loss": z}
 
     if vocab_chunk:
         from tensorflowonspark_tpu.ops.xent import blockwise_cross_entropy

@@ -51,11 +51,14 @@ def replicate(tree: Any, mesh) -> Any:
     arrays.  Host-staging guarantees fresh device buffers and also accepts
     sources committed to any device subset (e.g. an orbax restore on device
     0).  This runs once at job start; the copy cost is irrelevant.
+
+    Works on multi-process meshes too (every host holds the same full value;
+    assembly is delegated to ``mesh.shard_tree``).
     """
-    import numpy as np
+    from tensorflowonspark_tpu.parallel.mesh import shard_tree
 
     sharding = replicated(mesh)
-    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sharding), tree)
+    return shard_tree(mesh, tree, jax.tree.map(lambda _: sharding, tree))
 
 
 def make_train_step(
@@ -188,6 +191,7 @@ def make_batch_iterator(
     ctx=None,
     pad_to_batch: bool = True,
     prefetch: int = 2,
+    max_steps: int | None = -1,
 ):
     """Drain a DataFeed into device-ready, mesh-sharded batches.
 
@@ -202,8 +206,20 @@ def make_batch_iterator(
     caller's jitted step N is still executing — the conversion/transfer cost
     disappears behind the device step instead of serializing with it.  Set
     ``prefetch=0`` for strictly synchronous delivery.
+
+    ``max_steps`` >= 0 caps the number of yielded batches (the pipeline
+    layer's ``steps`` Param; reference ``args.steps`` semantics —
+    ``None`` and ``-1`` both mean uncapped, so ``args.get("steps")`` can be
+    passed straight through).  On
+    reaching the cap the host behaves exactly as if its feed ran dry: the
+    feed is ``terminate()``d (upstream streaming stops fast), the host keeps
+    voting in the ``all_done`` consensus, and on a multi-process mesh it
+    keeps joining the remaining global steps with filler batches — so a
+    capped host never deadlocks uncapped peers.
     """
-    inner = _batch_iterator(feed, batch_size, to_arrays, mesh, ctx, pad_to_batch)
+    inner = _batch_iterator(feed, batch_size, to_arrays, mesh, ctx,
+                            pad_to_batch,
+                            -1 if max_steps is None else int(max_steps))
     if prefetch <= 0:
         yield from inner
         return
@@ -272,17 +288,48 @@ def _batch_iterator(
     mesh=None,
     ctx=None,
     pad_to_batch: bool = True,
+    max_steps: int = -1,
 ):
-    from tensorflowonspark_tpu.parallel.mesh import shard_batch
+    from tensorflowonspark_tpu.parallel.mesh import is_multiprocess, shard_batch
 
     if getattr(feed, "input_mapping", None):
         raise ValueError(
             "make_batch_iterator needs row-shaped batches; construct the "
             "DataFeed without input_mapping and map columns in to_arrays"
         )
+    # Multi-host SPMD (jax.distributed + a mesh spanning processes): every
+    # process runs ONE jitted global step per consensus round, so the number
+    # of yielded batches must be identical on every host.  A host whose feed
+    # runs dry before the others keeps yielding FILLER batches (its last real
+    # sample repeated, reported as n=0) until the all_done consensus turns
+    # true — if it just skipped rounds, the still-active hosts would enter
+    # the next collective without it and the job would hang (SURVEY.md
+    # §5.8-3; the reference's MWMS had the same no-early-exit constraint).
+    multiproc = mesh is not None and is_multiprocess(mesh)
+    if multiproc and ctx is None:
+        raise ValueError(
+            "multi-process mesh streaming requires ctx: the all_done "
+            "consensus is what keeps per-host global-step counts in lockstep"
+        )
+    if multiproc and not pad_to_batch:
+        raise ValueError(
+            "multi-process mesh streaming requires pad_to_batch=True: every "
+            "host must contribute the same local batch shape or the global "
+            "batch assembly (make_array_from_process_local_data) diverges"
+        )
+    last_item = None   # filler source for multi-process end-of-data rounds
     exhausted = False  # feed hit end-of-feed: NEVER call next_batch again
     dry = False        # exhausted and nothing left to yield
+    yielded = 0
     while True:
+        if max_steps >= 0 and yielded >= max_steps and not dry:
+            # steps cap: behave exactly like end-of-data from here on —
+            # terminate the feed (upstream streaming stops fast, reference
+            # args.steps semantics) and vote dry in the consensus.
+            terminate = getattr(feed, "terminate", None)
+            if terminate is not None and not exhausted:
+                terminate()
+            exhausted = dry = True
         items: list = []
         if not dry:
             if not exhausted:
@@ -298,16 +345,27 @@ def _batch_iterator(
             # until everyone is dry, so no host exits the SPMD loop early.
             if ctx.all_done(dry):
                 return
-            if dry:
-                continue
         elif dry:
             return
-        if not items:
+        if not items and not multiproc:
             continue
         n = len(items)
-        if pad_to_batch and n < batch_size:
-            items = list(items) + [items[-1]] * (batch_size - n)
+        if not items:
+            # multiproc: this host is dry (or drew an empty batch) but other
+            # hosts still have data — join their global step with a filler.
+            if last_item is None:
+                raise RuntimeError(
+                    "multi-process streaming: this host reached end-of-feed "
+                    "before receiving any data; every data node needs at "
+                    "least one sample to participate in the global SPMD step"
+                )
+            items = [last_item] * batch_size
+        else:
+            last_item = items[-1]
+        if pad_to_batch and len(items) < batch_size:
+            items = list(items) + [items[-1]] * (batch_size - len(items))
         batch = to_arrays(items)
         if mesh is not None:
             batch = shard_batch(mesh, batch)
         yield batch, n
+        yielded += 1

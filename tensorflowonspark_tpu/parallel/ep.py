@@ -1,16 +1,27 @@
 """Expert parallelism: mixture-of-experts FFN sharded over the ``ep`` axis.
 
-Absent in the reference (SURVEY.md §2.3).  TPU-idiomatic MoE is the
-GShard/Switch einsum formulation: top-k routing with a *static* per-expert
-capacity, dispatch/combine as one-hot einsums (MXU-friendly, no dynamic
-shapes), expert-stacked weights with the expert dimension sharded over
-``ep`` — GSPMD turns the dispatch einsums into all-to-alls over ICI.
-Overflow tokens beyond capacity are dropped (their combine weight is zero),
-the standard capacity-factor trade-off.
+Absent in the reference (SURVEY.md §2.3).  TPU-idiomatic MoE keeps the
+GShard/Switch *static-capacity* contract (top-k routing, per-expert capacity
+``c``, overflow dropped — no dynamic shapes anywhere) but dispatches with
+**sorted indices** instead of the classic one-hot einsums: the einsum
+formulation materializes ``[n, e, c]`` dispatch/combine tensors, which at
+serious shapes (16k tokens × 64 experts × c=512) is ~2 GB *per tensor per
+layer*; the sort formulation carries only ``[n·k]`` index/gate vectors and
+scatters straight into the ``[e, c, d]`` expert buffers — the MegaBlocks /
+modern-maxtext-style dropping dispatch, here with slot assignment matched
+bit-for-bit to the GShard priority rule (see ``_sorted_dispatch``).
+
+Expert-stacked weights keep the expert dimension sharded over ``ep``; the
+``P('ep', …)`` constraints on the expert buffers make GSPMD materialize the
+token shuffle as all-to-alls over ICI exactly as before.
 
 ``MoEMLP`` is a flax module usable standalone or inside
-``models/transformer.py``; the load-balancing auxiliary loss is sown into
-the ``"aux_loss"`` collection (fetch with ``mutable=["aux_loss"]``).
+``models/transformer.py``.  Two auxiliary losses are sown into the
+``"aux_loss"`` collection (fetch with ``mutable=["aux_loss"]``):
+``load_balance`` (Switch eq. 4) and ``router_z`` (ST-MoE z-loss,
+``mean(logsumexp(router_logits)^2)`` — keeps router logits from drifting
+into f32-overflow territory); ``models.transformer.make_loss_fn`` weights
+them independently.
 """
 
 from __future__ import annotations
@@ -29,12 +40,54 @@ def _one_hot(x, n):
     return jax.nn.one_hot(x, n, dtype=jnp.float32)
 
 
+def _sorted_dispatch(top_idx, top_p, capacity: int, n_experts: int):
+    """GShard slot assignment without one-hot tensors.
+
+    Returns ``(slots, token_ids, gates, keep)``, each ``[n · k]`` flat over
+    (choice-round j, sorted-token) pairs: ``slots`` is the flat
+    expert-buffer slot (``expert · capacity + position``, or ``e ·
+    capacity`` for dropped pairs), ``token_ids`` the source token of each
+    pair, ``gates`` its normalized routing weight.
+
+    Slot semantics are IDENTICAL to the classic priority-loop formulation
+    (mesh-tf Switch / GShard): within round j, positions are assigned in
+    token order (stable sort by expert id = rank within expert); rounds are
+    processed in priority order, and only KEPT assignments from earlier
+    rounds advance an expert's fill counter.  All shapes static; the sorts
+    are ``[n]``-sized and jit-friendly.
+    """
+    n, k = top_idx.shape
+    e = n_experts
+    counts = jnp.zeros((e,), jnp.int32)       # kept fills per expert so far
+    slots, toks, gates, keeps = [], [], [], []
+    for j in range(k):
+        eid = top_idx[:, j]
+        order = jnp.argsort(eid, stable=True)
+        sorted_eid = eid[order]
+        starts = jnp.searchsorted(sorted_eid, jnp.arange(e), side="left")
+        # rank of this pair within its expert (token order) + prior fills
+        pos = jnp.arange(n) - starts[sorted_eid] + counts[sorted_eid]
+        keep = pos < capacity
+        slots.append(jnp.where(keep, sorted_eid * capacity + pos, e * capacity))
+        toks.append(order)
+        gates.append(top_p[order, j])
+        keeps.append(keep)
+        counts = counts.at[sorted_eid].add(keep.astype(jnp.int32))
+    return (jnp.concatenate(slots), jnp.concatenate(toks),
+            jnp.concatenate(gates), jnp.concatenate(keeps))
+
+
 class MoEMLP(nn.Module):
     """Top-k routed SwiGLU MoE FFN, ``[B, S, D] -> [B, S, D]``.
 
     Param layout (matched by ``tp.TRANSFORMER_TP_RULES``): ``router/kernel``
     replicated; ``experts_gate``/``experts_up`` ``[E, D, F]`` and
     ``experts_down`` ``[E, F, D]`` sharded ``P('ep', …)`` (+ tp on F).
+
+    ``dispatch='sort'`` (default) uses the index-based dispatch
+    (O(n·k) bookkeeping); ``'einsum'`` keeps the classic one-hot
+    formulation (O(n·e·c) memory — fine for tests/small shapes, and the
+    parity reference for the sort path).
     """
 
     d_model: int
@@ -43,6 +96,7 @@ class MoEMLP(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.float32
+    dispatch: str = "sort"        # sort | einsum
 
     @nn.compact
     def __call__(self, x):
@@ -53,16 +107,69 @@ class MoEMLP(nn.Module):
 
         router = nn.Dense(e, use_bias=False, name="router",
                           dtype=jnp.float32)  # routing always f32
-        probs = jax.nn.softmax(router(xf.astype(jnp.float32)), axis=-1)
+        router_logits = router(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)
         top_p, top_idx = jax.lax.top_k(probs, self.top_k)         # [n, k]
         top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
 
         capacity = max(1, int(math.ceil(n * self.capacity_factor
                                         * self.top_k / e)))
 
-        # GShard dispatch: slots are filled in top-k priority order; a
-        # token's j-th choice only lands if the expert still has room after
-        # all higher-priority assignments.
+        # Load-balancing aux loss (Switch eq. 4): e · Σ_e f_e · P_e .
+        frac_tokens = jnp.mean(_one_hot(top_idx[:, 0], e), axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        self.sow("aux_loss", "load_balance",
+                 e * jnp.sum(frac_tokens * frac_probs))
+        # Router z-loss (ST-MoE): keeps router logits bounded.
+        z = jax.scipy.special.logsumexp(router_logits, axis=-1)
+        self.sow("aux_loss", "router_z", jnp.mean(z * z))
+
+        w_gate = self.param("experts_gate", nn.initializers.lecun_normal(),
+                            (e, d, self.d_ff))
+        w_up = self.param("experts_up", nn.initializers.lecun_normal(),
+                          (e, d, self.d_ff))
+        w_down = self.param("experts_down", nn.initializers.lecun_normal(),
+                            (e, self.d_ff, d))
+        cdt = self.compute_dtype
+
+        if self.dispatch == "einsum":
+            expert_in, combine = self._einsum_dispatch(xf, top_idx, top_p,
+                                                       capacity, cdt)
+        else:
+            slots, toks, gates, keeps = _sorted_dispatch(top_idx, top_p,
+                                                         capacity, e)
+            x_pairs = xf[toks].astype(cdt) * keeps[..., None].astype(cdt)
+            expert_in = (jnp.zeros((e * capacity, d), cdt)
+                         .at[slots].add(x_pairs, mode="drop")
+                         .reshape(e, capacity, d))
+
+        # The ep constraints make GSPMD materialise the token shuffle as
+        # all-to-alls over the ep axis (tokens in, expert outputs back).
+        expert_in = constrain(expert_in, P("ep", None, None))
+        h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                    w_gate.astype(cdt)))
+             * jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cdt)))
+        h = constrain(h, P("ep", None, "tp"))
+        out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cdt))
+        out = constrain(out, P("ep", None, None))
+
+        if self.dispatch == "einsum":
+            y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+        else:
+            # gather each kept pair's expert output, weight by its gate,
+            # scatter-add back to its source token
+            out_flat = out.reshape(e * capacity, d)
+            safe = jnp.minimum(slots, e * capacity - 1)
+            contrib = (out_flat[safe]
+                       * gates[..., None].astype(cdt)
+                       * keeps[..., None].astype(cdt))
+            y = jnp.zeros((n, d), cdt).at[toks].add(contrib)
+        return y.reshape(b, s, d).astype(x.dtype)
+
+    def _einsum_dispatch(self, xf, top_idx, top_p, capacity, cdt):
+        """Classic GShard one-hot dispatch/combine (parity reference)."""
+        e = self.n_experts
+        n = xf.shape[0]
         counts = jnp.zeros((e,), jnp.float32)
         dispatch = jnp.zeros((n, e, capacity), jnp.float32)
         combine = jnp.zeros((n, e, capacity), jnp.float32)
@@ -76,31 +183,6 @@ class MoEMLP(nn.Module):
             d_j = keep[:, :, None] * slot[:, None, :]
             dispatch = dispatch + d_j
             combine = combine + d_j * top_p[:, j][:, None, None]
-
-        # Load-balancing aux loss (Switch eq. 4): e · Σ_e f_e · P_e .
-        frac_tokens = jnp.mean(_one_hot(top_idx[:, 0], e), axis=0)
-        frac_probs = jnp.mean(probs, axis=0)
-        self.sow("aux_loss", "load_balance",
-                 e * jnp.sum(frac_tokens * frac_probs))
-
-        w_gate = self.param("experts_gate", nn.initializers.lecun_normal(),
-                            (e, d, self.d_ff))
-        w_up = self.param("experts_up", nn.initializers.lecun_normal(),
-                          (e, d, self.d_ff))
-        w_down = self.param("experts_down", nn.initializers.lecun_normal(),
-                            (e, self.d_ff, d))
-
-        cdt = self.compute_dtype
-        # The ep constraints make GSPMD materialise the token shuffle as
-        # all-to-alls over the ep axis (tokens in, expert outputs back).
         expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt),
                                xf.astype(cdt))
-        expert_in = constrain(expert_in, P("ep", None, None))
-        h = (jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
-                                    w_gate.astype(cdt)))
-             * jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cdt)))
-        h = constrain(h, P("ep", None, "tp"))
-        out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cdt))
-        out = constrain(out, P("ep", None, None))
-        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
-        return y.reshape(b, s, d).astype(x.dtype)
+        return expert_in, combine

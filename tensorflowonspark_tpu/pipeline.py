@@ -182,6 +182,12 @@ class HasReservationTimeout(Params):
                                 "seconds to wait for all nodes to register")
 
 
+class HasJaxDistributed(Params):
+    jax_distributed = Param("jax_distributed", False,
+                            "bootstrap one multi-host jax.distributed job "
+                            "over the cluster (global mesh spanning nodes)")
+
+
 class Namespace:
     """Attribute-style argv bag (reference ``Namespace``, pipeline.py:~300-380).
 
@@ -230,7 +236,7 @@ class TPUParams(HasBatchSize, HasEpochs, HasSteps, HasInputMapping,
                 HasOutputMapping, HasInputMode, HasMasterNode, HasNumExecutors,
                 HasModelDir, HasExportDir, HasTFRecordDir, HasTensorboard,
                 HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout,
-                HasShuffleSeed):
+                HasShuffleSeed, HasJaxDistributed):
     """All framework params in one mixin stack (reference ``TFParams``)."""
 
     def merge_args_params(self, tf_args: Any = None) -> Namespace:
@@ -258,10 +264,24 @@ class TPUEstimator(TPUParams):
     either way.
     """
 
-    def __init__(self, train_fn: Callable, tf_args: Any = None, **params: Any):
+    def __init__(self, train_fn: Callable, tf_args: Any = None,
+                 launcher: Any = None, env: dict | None = None,
+                 per_node_env: list | None = None, **params: Any):
         super().__init__(**params)
         self.train_fn = train_fn
         self.tf_args = tf_args
+        # Live placement objects ride on the estimator, not the Params bag
+        # (a launcher is not a serializable config value): ``launcher`` e.g.
+        # a TPUPodLauncher for multi-host pods, ``env``/``per_node_env`` the
+        # same env layering cluster.run takes.  Together with the
+        # ``jax_distributed`` Param this opens the full multi-host path to
+        # the pipeline surface (reference: Spark placed executors for
+        # ``pipeline.py:~400-500``; here placement is explicit).
+        self.launcher = launcher
+        self.env = env
+        self.per_node_env = per_node_env
+        # post-run node metadata view (filled by fit, success OR failure)
+        self.last_cluster_info: list | None = None
 
     def fit(self, dataset: Any) -> "TPUModel":
         args = self.merge_args_params(self.tf_args)
@@ -287,14 +307,29 @@ class TPUEstimator(TPUParams):
             log_dir=args.log_dir,
             feed_timeout=args.feed_timeout,
             reservation_timeout=args.reservation_timeout,
+            launcher=self.launcher,
+            env=self.env,
+            per_node_env=self.per_node_env,
+            jax_distributed=bool(args.get("jax_distributed")),
         )
         try:
             if input_mode == InputMode.STREAMING:
                 cluster.train(data, num_epochs=args.epochs,
                               shuffle_seed=args.shuffle_seed)
         finally:
-            cluster.shutdown()
-        model = TPUModel(tf_args=args)
+            try:
+                cluster.shutdown()
+            finally:
+                # post-run node metadata (update_meta patches: device facts,
+                # step counts, TB url) — the observability view the
+                # reference exposed through TFCluster; captured even when
+                # shutdown re-raises a node error, so failed runs can be
+                # diagnosed from it
+                self.last_cluster_info = cluster.coordinator.cluster_info()
+        # the fitted model inherits the placement surface: transform() on a
+        # pod-trained model must score on the same hosts, not default-local
+        model = TPUModel(tf_args=args, launcher=self.launcher, env=self.env,
+                         per_node_env=self.per_node_env)
         model.set("export_dir", args.export_dir)
         for name in ("batch_size", "input_mapping", "output_mapping"):
             if self.is_set(name):
@@ -307,9 +342,16 @@ class TPUEstimator(TPUParams):
 class TPUModel(TPUParams):
     """Batch inference over a partitioned dataset from an exported bundle."""
 
-    def __init__(self, tf_args: Any = None, **params: Any):
+    def __init__(self, tf_args: Any = None, launcher: Any = None,
+                 env: dict | None = None, per_node_env: list | None = None,
+                 **params: Any):
         super().__init__(**params)
         self.tf_args = tf_args
+        # Same placement surface as TPUEstimator (each call to transform
+        # launches a fresh scoring cluster through these).
+        self.launcher = launcher
+        self.env = env
+        self.per_node_env = per_node_env
 
     def transform(self, dataset: Any) -> PartitionedDataset:
         """Score rows on a cluster of executors; preserves partition order/count.
@@ -341,6 +383,10 @@ class TPUModel(TPUParams):
             input_mode=InputMode.STREAMING,
             feed_timeout=args.feed_timeout,
             reservation_timeout=args.reservation_timeout,
+            launcher=self.launcher,
+            env=self.env,
+            per_node_env=self.per_node_env,
+            jax_distributed=bool(args.get("jax_distributed")),
         )
         try:
             pred_parts = cluster.inference(data, flat=False)

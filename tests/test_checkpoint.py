@@ -139,6 +139,32 @@ def test_bundle_roundtrip(tmp_path):
     assert again[2] is apply_fn
 
 
+def test_bundle_roundtrip_bf16_params(tmp_path):
+    """ml_dtypes params must survive the npz bundle: np.savez writes bfloat16
+    as raw void bytes ('|V2' on load), so export_bundle records dtype names
+    and load_bundle views the bytes back (the README's own bf16-cast serving
+    recipe would otherwise load as garbage)."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    rng = np.random.RandomState(0)
+    params = {"dense": {"kernel": rng.randn(4, 3).astype(ml_dtypes.bfloat16),
+                        "bias": rng.randn(3).astype(np.float32)}}
+    ckpt.export_bundle(str(tmp_path / "b"), params, {"model": "x"})
+    loaded, config = ckpt.load_bundle(str(tmp_path / "b"))
+    assert config == {"model": "x"}  # reserved dtype field stripped
+    assert loaded["dense"]["kernel"].dtype == ml_dtypes.bfloat16
+    assert loaded["dense"]["bias"].dtype == np.float32
+    np.testing.assert_array_equal(
+        np.asarray(loaded["dense"]["kernel"], np.float32),
+        np.asarray(params["dense"]["kernel"], np.float32))
+    # the loaded tree is directly usable as jax compute input
+    out = jax.jit(lambda p, x: x @ p["dense"]["kernel"].astype(jnp.float32))(
+        loaded, jnp.ones((2, 4)))
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_stablehlo_export_consumable_without_package(tmp_path):
     """Serving interop (VERDICT r2 item 10): the StableHLO artifact must
     reload and score in a process that never imports tensorflowonspark_tpu —

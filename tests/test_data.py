@@ -54,3 +54,54 @@ def test_repartition():
     ds = PartitionedDataset.from_iterable(range(6), 2).repartition(3)
     assert ds.num_partitions == 3
     assert list(ds) == list(range(6))
+
+
+def test_interleave_inline_single_reader():
+    from tensorflowonspark_tpu.data import interleave
+
+    factories = [lambda a=a: iter(range(a, a + 3)) for a in (0, 10, 20)]
+    # num_readers<=1: inline, deterministic source order
+    assert list(interleave(factories, num_readers=1)) == [
+        0, 1, 2, 10, 11, 12, 20, 21, 22]
+
+
+def test_interleave_parallel_complete_and_source_ordered():
+    from tensorflowonspark_tpu.data import interleave
+
+    factories = [lambda a=a: iter(range(a, a + 50)) for a in (0, 100, 200, 300)]
+    got = list(interleave(factories, num_readers=3, buffer_size=8))
+    assert sorted(got) == sorted(sum((list(range(a, a + 50))
+                                      for a in (0, 100, 200, 300)), []))
+    # within one source, order is preserved even across thread interleaving
+    for a in (0, 100, 200, 300):
+        assert [x for x in got if a <= x < a + 50] == list(range(a, a + 50))
+
+
+def test_interleave_propagates_reader_errors():
+    from tensorflowonspark_tpu.data import interleave
+
+    def bad():
+        yield 1
+        raise ValueError("reader exploded")
+
+    with pytest.raises(ValueError, match="reader exploded"):
+        list(interleave([bad, lambda: iter(range(3))], num_readers=2))
+
+
+def test_interleave_abandoned_consumer_stops_threads():
+    import threading
+
+    from tensorflowonspark_tpu.data import interleave
+
+    before = threading.active_count()
+    it = interleave([lambda a=a: iter(range(a, a + 1000)) for a in (0, 5000)],
+                    num_readers=2, buffer_size=4)
+    next(it)
+    it.close()
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before

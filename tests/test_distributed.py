@@ -70,6 +70,123 @@ def test_two_process_jax_distributed_psum(tmp_path):
         assert m["device"]["num_devices"] == 2
 
 
+def _linreg_partitions(num_partitions: int, rows_per_partition: int):
+    """Deterministic (x, y) rows; partition p is reproducible from its index."""
+    import numpy as np
+
+    parts = []
+    for p in range(num_partitions):
+        rng = np.random.RandomState(100 + p)
+        parts.append([
+            (rng.randn(4).astype(np.float32), float(rng.randn()))
+            for _ in range(rows_per_partition)
+        ])
+    return parts
+
+
+def _numpy_sgd_reference(global_batches, lr=0.1):
+    """Host-side replica of mapfuns.train_streaming_dist's model/optimizer."""
+    import numpy as np
+
+    w = np.full((4, 1), 0.5, np.float32)
+    b = np.zeros((1,), np.float32)
+    losses = []
+    for xs, ys in global_batches:
+        e = (xs @ w)[:, 0] + b[0] - ys
+        losses.append(float(np.mean(e * e)))
+        n = len(ys)
+        w = w - lr * (2.0 / n) * (xs.T @ e)[:, None]
+        b = b - lr * (2.0 / n) * np.sum(e)
+    return losses, w
+
+
+@pytest.mark.slow
+def test_two_process_streaming_training(tmp_path):
+    """The reference's defining combination (SURVEY §3.2/§5.8-3): driver
+    streams DISJOINT partitions to each of 2 jax.distributed processes; every
+    step is ONE global SPMD program over the concatenated global batch.
+    Losses must be identical across hosts and match a single-process numpy
+    replica of the same global batch sequence."""
+    import numpy as np
+
+    from tests import mapfuns
+
+    bs = 4
+    parts = _linreg_partitions(num_partitions=4, rows_per_partition=bs)
+    env = tpu_info.chip_visibility_env((), platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        mapfuns.train_streaming_dist,
+        {"batch_size": bs},
+        num_executors=2,
+        input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(),
+        env=env,
+        jax_distributed=True,
+        log_dir=str(tmp_path),
+        reservation_timeout=180.0,
+    )
+    cluster.train(parts, num_epochs=1)
+    cluster.shutdown(timeout=300.0)
+    infos = {m["executor_id"]: m.get("stream_dist")
+             for m in cluster.coordinator.cluster_info()}
+    assert all(i is not None for i in infos.values()), f"missing: {infos}"
+    for info in infos.values():
+        assert info["process_count"] == 2
+        assert info["global_devices"] == 4
+    # both hosts observed the SAME global losses (replicated scalar out of
+    # one shared SPMD program) and trained on every one of their batches
+    assert infos[0]["losses"] == infos[1]["losses"]
+    assert infos[0]["ns"] == [bs, bs] and infos[1]["ns"] == [bs, bs]
+    # global batch k = node0's k-th partition ++ node1's k-th partition
+    # (round-robin placement: node0 gets partitions 0,2; node1 gets 1,3;
+    # process order in the global array follows process_index)
+    global_batches = []
+    for k in range(2):
+        rows = parts[2 * k] + parts[2 * k + 1]
+        xs = np.stack([r[0] for r in rows])
+        ys = np.asarray([r[1] for r in rows], np.float32)
+        global_batches.append((xs, ys))
+    ref_losses, ref_w = _numpy_sgd_reference(global_batches)
+    np.testing.assert_allclose(infos[0]["losses"], ref_losses, rtol=1e-4)
+    np.testing.assert_allclose(infos[0]["final_w"], ref_w.ravel(), rtol=1e-4)
+    np.testing.assert_allclose(infos[1]["final_w"], ref_w.ravel(), rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_two_process_streaming_uneven_partitions(tmp_path):
+    """End-of-data lockstep: node0 gets 3 partitions, node1 gets 2.  Node1
+    must keep joining the global step with filler batches (n=0) until the
+    all_done consensus fires — same number of global steps on both hosts, no
+    hang (the MWMS no-early-exit constraint, SURVEY §5.8-3)."""
+    from tests import mapfuns
+
+    bs = 4
+    parts = _linreg_partitions(num_partitions=5, rows_per_partition=bs)
+    env = tpu_info.chip_visibility_env((), platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        mapfuns.train_streaming_dist,
+        {"batch_size": bs},
+        num_executors=2,
+        input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(),
+        env=env,
+        jax_distributed=True,
+        log_dir=str(tmp_path),
+        reservation_timeout=180.0,
+    )
+    cluster.train(parts, num_epochs=1)
+    cluster.shutdown(timeout=300.0)
+    infos = {m["executor_id"]: m.get("stream_dist")
+             for m in cluster.coordinator.cluster_info()}
+    assert all(i is not None for i in infos.values()), f"missing: {infos}"
+    # node0: partitions 0,2,4 -> 3 real batches; node1: 1,3 -> 2 real + 1 filler
+    assert infos[0]["ns"] == [bs, bs, bs]
+    assert infos[1]["ns"] == [bs, bs, 0]
+    assert len(infos[0]["losses"]) == len(infos[1]["losses"]) == 3
+    assert infos[0]["losses"] == infos[1]["losses"]
+    assert all(l == l and l < float("inf") for l in infos[0]["losses"])
+
+
 @pytest.mark.slow
 def test_pod_launcher_local_transport_two_hosts(tmp_path):
     """A '2-host pod' on localhost through TPUPodLauncher(transport='local'):

@@ -86,6 +86,45 @@ def test_moe_decode_cache_matches_full_forward():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_chunked_prefill_matches_per_token_prefill(lm):
+    """The [B,S] prefill slab must produce the same logits at every prompt
+    position AND leave the cache byte-identical to S sequential single-token
+    steps — so generation after either prefill is indistinguishable."""
+    model, ids, params = lm
+    L = ids.shape[1]
+    dmodel = model.clone(decode=True, max_decode_len=L)
+
+    def empty_cache():
+        return jax.tree.map(jnp.zeros_like, dmodel.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))["cache"])
+
+    step = jax.jit(lambda c, t: dmodel.apply(
+        {"params": params, "cache": c}, t, mutable=["cache"]))
+
+    prompt = ids[:, :7]
+    per_token_logits = []
+    cache1 = empty_cache()
+    for i in range(7):
+        logits, mutated = step(cache1, prompt[:, i : i + 1])
+        cache1 = mutated["cache"]
+        per_token_logits.append(np.asarray(logits[:, 0]))
+
+    chunk_logits, mutated = step(empty_cache(), prompt)  # ONE compiled call
+    cache2 = mutated["cache"]
+    for i in range(7):
+        np.testing.assert_allclose(np.asarray(chunk_logits[:, i]),
+                                   per_token_logits[i], rtol=2e-5, atol=2e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5), cache1, cache2)
+    # continuing decode from the chunked cache matches greedy generation
+    out = tfm.greedy_generate(model, params, prompt, max_new_tokens=3,
+                              max_decode_len=L)
+    full = jax.jit(lambda p, x: model.apply({"params": p}, x))(
+        params, jnp.asarray(out[:, :7]))
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(full[:, -1], axis=-1)), out[:, 7])
+
+
 def test_pad_batch_masks_padding_out_of_loss(lm):
     model, _, params = lm
     batch = tfm.pad_batch([[1, 2, 3, 4, 5, 6], [7, 8]], seq_len=6)

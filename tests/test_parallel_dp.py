@@ -115,6 +115,29 @@ def test_batch_iterator_pads_final_batch():
     assert batches[-1][0].tolist() == [8, 9, 9, 9]  # padded with last sample
 
 
+def test_batch_iterator_max_steps_caps_and_terminates_feed():
+    """The pipeline `steps` Param: the iterator stops after max_steps batches
+    and terminates the feed (so upstream streaming stops fast) even with
+    data left."""
+    feed = feed_with(list(range(100)))
+    batches = list(make_batch_iterator(feed, 4, to_arrays=np.asarray,
+                                       max_steps=3))
+    assert len(batches) == 3
+    assert all(n == 4 for _, n in batches)
+    assert feed.should_stop()
+    assert feed.queues.get("state") == "terminating"  # drained upstream
+    # IteratorFeed (DIRECT mode) has no terminate(); the cap still applies
+    from tensorflowonspark_tpu.feeding import IteratorFeed
+
+    got = list(make_batch_iterator(IteratorFeed(iter(range(50))), 5,
+                                   to_arrays=np.asarray, max_steps=2))
+    assert len(got) == 2
+    # and max_steps larger than the data is a no-op
+    got = list(make_batch_iterator(IteratorFeed(iter(range(6))), 4,
+                                   to_arrays=np.asarray, max_steps=99))
+    assert [n for _, n in got] == [4, 2]
+
+
 def test_batch_iterator_prefetch_matches_sync():
     """The double-buffered path must deliver byte-identical batches in the
     same order as strictly-synchronous delivery (SURVEY.md §7.3-6)."""

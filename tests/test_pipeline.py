@@ -87,6 +87,50 @@ class TestFitTransform:
         assert all(np.allclose(r["features"], rows[i]["features"])
                    for i, r in enumerate(out))
 
+    def test_fit_steps_param_caps_training(self, tmp_path):
+        """setSteps(N) must stop each node after N train steps with data
+        left over (reference args.steps semantics) — the Param is consumed
+        by make_batch_iterator's max_steps, feed termination drops the rest."""
+        rows = wide_deep.synthetic_criteo(64, seed=2)
+        est = pipeline.TPUEstimator(mapfuns.train_wide_deep, {"vocab_size": 1009})
+        est.setNumExecutors(2).setEpochs(1).setBatchSize(8).setSteps(2)
+        est.set("export_dir", str(tmp_path / "export"))
+        est.set("log_dir", str(tmp_path / "logs"))
+        est.fit(PartitionedDataset.from_iterable(rows, 8))
+        # 64 rows / 2 nodes / bs 8 = 4 possible steps; capped at 2
+        assert [m["train_steps"] for m in est.last_cluster_info] == [2, 2]
+
+    @pytest.mark.slow
+    def test_fit_on_two_process_jax_distributed(self, tmp_path):
+        """The pipeline surface must reach the multi-host path (VERDICT r3
+        item 6): fit with jax_distributed=True on 2 node processes — one
+        global SPMD train step over both processes' devices, fed by
+        STREAMING partitions — then transform locally from the bundle."""
+        from tensorflowonspark_tpu import tpu_info
+        from tensorflowonspark_tpu.launcher import SubprocessLauncher
+
+        rows = wide_deep.synthetic_criteo(32, seed=4)
+        est = pipeline.TPUEstimator(
+            mapfuns.train_wide_deep, {"vocab_size": 1009},
+            launcher=SubprocessLauncher(),
+            env=tpu_info.chip_visibility_env((), platform="cpu",
+                                             simulate_chips=2),
+        )
+        est.setNumExecutors(2).setEpochs(1).setBatchSize(8)
+        est.setJaxDistributed(True)
+        est.set("export_dir", str(tmp_path / "export"))
+        est.set("log_dir", str(tmp_path / "logs"))
+        est.set("reservation_timeout", 180.0)
+        model = est.fit(PartitionedDataset.from_iterable(rows, 4))
+        assert os.path.isdir(tmp_path / "export")
+        # every data node took the same number of GLOBAL steps (lockstep)
+        steps = [m["train_steps"] for m in est.last_cluster_info]
+        assert len(set(steps)) == 1 and steps[0] >= 1
+        scored = model.transform(PartitionedDataset.from_iterable(rows[:10], 2))
+        out = list(scored)
+        assert len(out) == 10
+        assert all("prediction" in r for r in out)
+
     def test_estimator_requires_export_dir(self):
         est = pipeline.TPUEstimator(mapfuns.noop, {})
         with pytest.raises(ValueError, match="export_dir"):

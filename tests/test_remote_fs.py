@@ -30,6 +30,17 @@ import mnist_tfr  # noqa: E402
 TINY = {"features": [4, 8], "dense": 16, "batch_size": 16, "lr": 0.05}
 
 
+def test_unregistered_scheme_fails_fast_with_remedy():
+    """The README ops contract: a URI whose scheme has no registered mount
+    root must raise immediately, naming the scheme and the fix — never fall
+    back to a silent local-disk write."""
+    import pytest
+
+    with pytest.raises(ValueError, match=r"no local root registered for "
+                                         r"scheme 'nosuchfs'.*register_fs_root"):
+        resolve_uri("nosuchfs://namenode/a/b")
+
+
 def test_hopsfs_uri_end_to_end(tmp_path):
     register_fs_root("hopsfs", str(tmp_path))
     assert resolve_uri("hopsfs://nn/a/b") == str(tmp_path / "a" / "b")

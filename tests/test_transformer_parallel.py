@@ -81,11 +81,18 @@ def test_moe_forward_and_aux_loss():
     model, params, ids = tiny_model(n_experts=4)
     logits, updates = model.apply({"params": params}, ids, mutable=["aux_loss"])
     assert logits.shape == (4, 16, 64)
-    aux = jax.tree.leaves(updates["aux_loss"])
-    assert len(aux) == 2  # one per layer
-    # Perfectly balanced routing gives aux loss == 1.0; anything sane is near.
-    for a in aux:
+    flat = jax.tree_util.tree_flatten_with_path(updates["aux_loss"])[0]
+    lb = [leaf for path, leaf in flat
+          if not any("router_z" in str(p) for p in path)]
+    rz = [leaf for path, leaf in flat
+          if any("router_z" in str(p) for p in path)]
+    assert len(lb) == 2 and len(rz) == 2  # one of each per layer
+    # Perfectly balanced routing gives load-balance loss == 1.0.
+    for a in lb:
         assert 0.5 < float(a) < 4.0
+    # z-loss = mean(logsumexp(logits)^2) is strictly positive and finite.
+    for z in rz:
+        assert 0.0 < float(z) < 100.0
 
 
 def test_moe_ep_sharded_matches_replicated():
@@ -99,6 +106,79 @@ def test_moe_ep_sharded_matches_replicated():
             {"params": p}, x, mutable=["aux_loss"])[0])(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_moe_sort_dispatch_matches_einsum_reference():
+    """The index/sort-based dispatch (default; O(n·k) bookkeeping) must
+    reproduce the classic GShard one-hot einsum formulation exactly —
+    including which tokens overflow: slot assignment follows the same
+    priority rule (round-major, token order, kept-only carryover)."""
+    for cap_factor in (1.25, 0.4):  # ample capacity AND forced overflow
+        kwargs = dict(d_model=8, d_ff=16, n_experts=4, top_k=2,
+                      capacity_factor=cap_factor, compute_dtype=jnp.float32)
+        sort_layer = eplib.MoEMLP(**kwargs)
+        ein_layer = eplib.MoEMLP(**kwargs, dispatch="einsum")
+        x = jnp.asarray(np.random.RandomState(7).randn(2, 12, 8), jnp.float32)
+        params = sort_layer.init(jax.random.PRNGKey(1), x)["params"]
+        y_sort, aux_sort = jax.jit(lambda p, v: sort_layer.apply(
+            {"params": p}, v, mutable=["aux_loss"]))(params, x)
+        y_ein, aux_ein = jax.jit(lambda p, v: ein_layer.apply(
+            {"params": p}, v, mutable=["aux_loss"]))(params, x)
+        np.testing.assert_allclose(np.asarray(y_sort), np.asarray(y_ein),
+                                   rtol=1e-5, atol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6),
+            aux_sort, aux_ein)
+
+
+def test_moe_sort_dispatch_grads_match_einsum():
+    kwargs = dict(d_model=8, d_ff=16, n_experts=2, top_k=2,
+                  capacity_factor=1.25, compute_dtype=jnp.float32)
+    sort_layer = eplib.MoEMLP(**kwargs)
+    ein_layer = eplib.MoEMLP(**kwargs, dispatch="einsum")
+    x = jnp.asarray(np.random.RandomState(3).randn(1, 10, 8), jnp.float32)
+    params = sort_layer.init(jax.random.PRNGKey(2), x)["params"]
+
+    def loss(layer, p):
+        y = layer.apply({"params": p}, x, mutable=["aux_loss"])[0]
+        return jnp.sum(y * y)
+
+    g_sort = jax.grad(lambda p: loss(sort_layer, p))(params)
+    g_ein = jax.grad(lambda p: loss(ein_layer, p))(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), g_sort, g_ein)
+
+
+def test_moe_aux_losses_survive_remat():
+    """remat=True must thread the MoE aux sows through nn.remat: a silently
+    dropped load-balance/z-loss under rematerialization would detune MoE
+    training unnoticed (ADVICE r3).  Loss, aux metrics and grads must match
+    the remat=False model."""
+    ids = jnp.asarray(np.random.RandomState(11).randint(0, 64, (2, 16)),
+                      jnp.int32)
+    models = {
+        r: tfm.Transformer(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                           n_experts=4, attn_impl="xla",
+                           compute_dtype=jnp.float32, remat=r)
+        for r in (False, True)
+    }
+    params = models[False].init(jax.random.PRNGKey(0), ids)["params"]
+    results = {}
+    for r, model in models.items():
+        loss_fn = tfm.make_loss_fn(model)
+        (total, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, {"input_ids": ids})
+        results[r] = (total, metrics, grads)
+    t0, m0, g0 = results[False]
+    t1, m1, g1 = results[True]
+    assert float(m0["aux_loss"]) > 0.1 and float(m0["router_z_loss"]) > 0.0
+    np.testing.assert_allclose(float(t1), float(t0), rtol=1e-5)
+    np.testing.assert_allclose(float(m1["aux_loss"]), float(m0["aux_loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m1["router_z_loss"]),
+                               float(m0["router_z_loss"]), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), g1, g0)
 
 
 def test_moe_capacity_drops_overflow():
