@@ -266,6 +266,13 @@ class TPUCluster:
                     if 0 <= m.get("launch_index", -1) < len(procs)
                 }
                 for executor_id in self._feed_ids:
+                    proc = id_to_proc.get(executor_id)
+                    if proc is not None and not proc.is_alive():
+                        # node already finished and tore down its data plane;
+                        # an EOF would only block on a dead peer
+                        logger.debug("node %d already exited; skipping EOF",
+                                     executor_id)
+                        continue
                     for qname in self.input_qnames:
                         try:
                             self._client(executor_id).send_eof(qname)

@@ -110,6 +110,7 @@ class DataServer:
         self.port: int = self._sock.getsockname()[1]
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="dataserver")
+        self._ring_threads: list[threading.Thread] = []
 
     def start(self) -> int:
         self._thread.start()
@@ -121,6 +122,14 @@ class DataServer:
             self._sock.close()
         except OSError:
             pass
+        # Wait briefly for ring threads to run their cleanup (close_write):
+        # they are daemons, and if the node process exits before a ring's
+        # close_write, a driver blocked in ring.get() waits out its FULL call
+        # timeout (~minutes) instead of seeing RingClosed immediately — the
+        # teardown race behind sporadic 600s shutdown stalls.  The threads
+        # wake from their bounded waits within a few seconds.
+        for t in self._ring_threads:
+            t.join(timeout=8.0)
 
     # -- server internals ----------------------------------------------------
 
@@ -240,8 +249,10 @@ class DataServer:
                 s2c = shm_ring.ShmRing.create(capacity=capacity)
             except Exception as e:  # noqa: BLE001 - no compiler/shm: stay on TCP
                 return ("err", f"ring unavailable: {e}")
-            threading.Thread(target=self._serve_ring, args=(c2s, s2c),
-                             daemon=True, name="dataserver-ring").start()
+            t = threading.Thread(target=self._serve_ring, args=(c2s, s2c),
+                                 daemon=True, name="dataserver-ring")
+            self._ring_threads.append(t)
+            t.start()
             return ("ok", c2s.name, s2c.name)
         if op == "close":
             return ("ok",)
@@ -376,11 +387,12 @@ class DataClient:
             raise RuntimeError(f"data plane error: {reply[1] if len(reply) > 1 else reply!r}")
         return reply
 
-    def _call(self, msg: tuple) -> tuple:
+    def _call(self, msg: tuple, timeout: float | None = None) -> tuple:
+        timeout = self.call_timeout if timeout is None else timeout
         with self._lock:
             if self._c2s is not None:
                 try:
-                    self._c2s.put(msg, timeout=self.call_timeout)
+                    self._c2s.put(msg, timeout=timeout)
                 except (EOFError, TimeoutError, OSError, ValueError):
                     # Send failed ⇒ the server never saw the request: safe to
                     # downgrade to the healthy TCP socket and retry there.
@@ -389,7 +401,7 @@ class DataClient:
                     self._teardown_ring()
                 else:
                     try:
-                        return self._check(self._s2c.get(timeout=self.call_timeout))
+                        return self._check(self._s2c.get(timeout=timeout))
                     except (EOFError, TimeoutError, OSError, ValueError) as e:
                         # Reply path failed AFTER the server may have acted:
                         # retrying could double-feed, so surface the error.
@@ -397,8 +409,15 @@ class DataClient:
                         self._teardown_ring()
                         raise RuntimeError(
                             f"data plane error: ring reply lost ({e})") from e
-            _send(self._sock, msg)
-            return self._check(_recv(self._sock))
+            # TCP path honors the same bound: the socket is otherwise
+            # blocking, and e.g. a short-timeout EOF must not wait forever
+            # on a wedged (but alive) node.
+            self._sock.settimeout(timeout)
+            try:
+                _send(self._sock, msg)
+                return self._check(_recv(self._sock))
+            finally:
+                self._sock.settimeout(None)
 
     def _teardown_ring(self) -> None:
         if self._c2s is not None:
@@ -465,8 +484,11 @@ class DataClient:
                     f"{len(items)} results before {self.stall_timeout}s stall timeout")
         return results
 
-    def send_eof(self, qname: str = "input") -> None:
-        self._call(("eof", qname))
+    def send_eof(self, qname: str = "input", timeout: float = 20.0) -> None:
+        """EOF is a teardown-path control message: the node replies within
+        milliseconds or is gone — never wait the full feed timeout on it
+        (a node may exit between the driver's liveness check and this call)."""
+        self._call(("eof", qname), timeout=timeout)
 
     def close(self) -> None:
         if self._c2s is not None:

@@ -186,10 +186,10 @@ def shard_batch(mesh: Mesh, batch):
     if is_multiprocess(mesh):
         def put_local(x):
             x = np.asarray(x)
-            s = NamedSharding(mesh, P(("dp", "fsdp"), *([None] * (x.ndim - 1))))
-            return jax.make_array_from_process_local_data(s, x)
+            return jax.make_array_from_process_local_data(
+                batch_sharding(mesh, extra_dims=x.ndim - 1), x)
         return jax.tree.map(put_local, batch)
     return jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), *([None] * (x.ndim - 1))))),
+        lambda x: jax.device_put(x, batch_sharding(mesh, extra_dims=x.ndim - 1)),
         batch,
     )

@@ -2,6 +2,7 @@
 SURVEY.md §4 'Example-as-test'): direct-mode TFRecord training of the
 CIFAR-size ResNet through real node processes on CPU."""
 
+import pytest
 import os
 import sys
 
@@ -29,6 +30,7 @@ def test_cifar_model_forward_shape():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_direct_tfrecord_cifar_train(tmp_path):
     data_dir = str(tmp_path / "tfr")
     cifar10_train.prepare_data(data_dir, samples=32, partitions=2)

@@ -194,3 +194,28 @@ def test_ring_inference_roundtrip():
     t.join(5)
     client.close()
     server.stop()
+
+
+def test_send_eof_after_server_stop_fails_fast():
+    """Teardown race regression: a node can stop its data plane before the
+    driver's EOF arrives.  On the shm-ring transport that used to block for
+    the FULL call timeout (~minutes) because nothing closed the rings before
+    process exit; server.stop() now joins ring threads (rings close) and
+    send_eof carries its own short timeout.  The driver must see an error
+    within seconds either way."""
+    import time
+
+    from tensorflowonspark_tpu import shm_ring
+
+    queues, server, client = start_pair(feed_timeout=600.0)
+    if shm_ring.available():
+        assert client.using_ring
+    client.send_eof("input")  # healthy path works
+    server.stop()
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        client.send_eof("input")
+        # ring path may downgrade to TCP and fail there; either way:
+        client.send_eof("input")
+    assert time.monotonic() - t0 < 30.0
+    client.close()

@@ -70,6 +70,82 @@ def test_two_process_jax_distributed_psum(tmp_path):
         assert m["device"]["num_devices"] == 2
 
 
+def _dist_map_fun_check_env(args, ctx):
+    """_dist_map_fun plus: assert env values with spaces survived the ssh
+    shell-quoting (launcher.py ssh branch joins argv into one remote shell
+    line — the exact bug class only an executed transport catches)."""
+    import os
+
+    expected = args["expect_env"]
+    for key, want in expected.items():
+        got = os.environ.get(key)
+        assert got == want, f"env {key!r}: {got!r} != {want!r}"
+    _dist_map_fun(args, ctx)
+
+
+@pytest.mark.slow
+def test_pod_launcher_ssh_transport_two_hosts(tmp_path, monkeypatch):
+    """Drive the REAL ssh branch end-to-end with a fake `ssh` on PATH that
+    execs the remote shell line locally (`bash -c "$*"`), exactly as sshd's
+    remote shell would.  Covers: argv quoting (env values with spaces),
+    stdin payload delivery, per-host env composition, log routing, and the
+    2-process global mesh."""
+    import os
+    import stat
+
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    # argv: ssh -o BatchMode=yes <host> <tok> <tok> ...  → record, then run
+    # the joined remote line through a shell (what sshd does remotely)
+    shim.write_text(
+        "#!/bin/bash\n"
+        f'echo "$@" >> {tmp_path}/ssh_calls.log\n'
+        'if [ "$1" = "-o" ]; then shift 2; fi\n'
+        "host=$1; shift\n"
+        'exec bash -c "$*"\n'
+    )
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{shim_dir}{os.pathsep}{os.environ['PATH']}")
+
+    from tensorflowonspark_tpu.launcher import TPUPodLauncher
+
+    spaced = "--fake_a=1 --fake_b='two words'"
+    # Real ssh does NOT inherit the driver's sys.path (remote hosts have
+    # their own installs); the shim execs locally, so ship the import path
+    # explicitly as pod env — which also covers quoting of ':'-joined values.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pod = TPUPodLauncher(hosts=["pod-host-0", "pod-host-1"], transport="ssh",
+                         platform="cpu", simulate_chips=2,
+                         env={"TOS_TEST_SPACES": spaced,
+                              "PYTHONPATH": f"{repo}{os.pathsep}{os.path.join(repo, 'tests')}"})
+    cluster = tcluster.run(
+        _dist_map_fun_check_env,
+        {"expect_env": {"TOS_TEST_SPACES": spaced}},
+        num_executors=2,
+        input_mode=tcluster.InputMode.DIRECT,
+        launcher=pod,
+        log_dir=str(tmp_path / "logs"),
+        reservation_timeout=180,
+    )
+    cluster.shutdown(timeout=300.0)
+    infos = [m.get("dist_check") for m in cluster.coordinator.cluster_info()]
+    assert all(i is not None for i in infos), f"missing dist_check: {infos}"
+    for info in infos:
+        assert info["process_count"] == 2
+        assert info["global_devices"] == 4
+        assert info["global_sum"] == 6.0
+    # the shim really was the transport: one call per host, BatchMode set
+    calls = (tmp_path / "ssh_calls.log").read_text().strip().splitlines()
+    assert len(calls) == 2
+    hosts = {c.split()[2] for c in calls}
+    assert hosts == {"pod-host-0", "pod-host-1"}
+    assert all(c.startswith("-o BatchMode=yes") for c in calls)
+    # log routing: one node log per host with node output in it
+    for i in (0, 1):
+        assert (tmp_path / "logs" / f"node_{i}.log").exists()
+
+
 def _linreg_partitions(num_partitions: int, rows_per_partition: int):
     """Deterministic (x, y) rows; partition p is reproducible from its index."""
     import numpy as np

@@ -1,5 +1,6 @@
 """Inception-v3 + streaming-inference-loop tests (parity config 5)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -30,6 +31,7 @@ def test_inception_registry():
     assert model.num_classes == 7
 
 
+@pytest.mark.slow
 def test_bundle_inference_loop_e2e(tmp_path):
     """Streaming inference through a real cluster with a bundle-driven
     map_fun: ordered, exactly-count results (SURVEY.md §3.3 invariant).

@@ -46,13 +46,16 @@ def test_greedy_generate_matches_teacher_forcing(lm):
     assert out.shape == (2, 9)
     np.testing.assert_array_equal(out[:, :4], np.asarray(prompt))
 
-    # replaying the generated prefix through the full model must predict the
-    # same next token at each generated position (greedy = argmax chain)
+    # replaying the generated sequence through the full model must predict
+    # the same next token at each generated position (greedy = argmax
+    # chain).  Causal attention makes ONE forward over the whole output
+    # equivalent to a forward per prefix: logits[:, t-1] depends only on
+    # tokens < t.
+    full = jax.jit(lambda p, x: model.apply({"params": p}, x))(
+        params, jnp.asarray(out))
     for t in range(4, 9):
-        full = jax.jit(lambda p, x: model.apply({"params": p}, x))(
-            params, jnp.asarray(out[:, : t]))
         np.testing.assert_array_equal(
-            np.asarray(jnp.argmax(full[:, -1], axis=-1)), out[:, t])
+            np.asarray(jnp.argmax(full[:, t - 1], axis=-1)), out[:, t])
 
 
 def test_moe_decode_cache_matches_full_forward():

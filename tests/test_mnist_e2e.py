@@ -22,6 +22,7 @@ import mnist_tfr  # noqa: E402
 TINY = {"features": [4, 8], "dense": 16, "batch_size": 16, "lr": 0.05}
 
 
+@pytest.mark.slow
 def test_streaming_train_then_inference(tmp_path):
     from tensorflowonspark_tpu.models.mnist import synthetic_mnist
 
@@ -60,6 +61,7 @@ def test_streaming_train_then_inference(tmp_path):
     assert acc > 0.5, f"accuracy {acc}"
 
 
+@pytest.mark.slow
 def test_restart_resumes_from_checkpoint(tmp_path):
     """Whole-job restart (SURVEY.md §5.3 recovery contract): a second cluster
     pointed at the same model_dir must resume from the saved FULL train state

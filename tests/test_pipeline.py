@@ -62,6 +62,7 @@ class TestNamespace:
 
 
 class TestFitTransform:
+    @pytest.mark.slow
     def test_fit_then_transform(self, tmp_path):
         rows = wide_deep.synthetic_criteo(32, seed=1)
         data = PartitionedDataset.from_iterable(rows, 4)
@@ -90,15 +91,26 @@ class TestFitTransform:
     def test_fit_steps_param_caps_training(self, tmp_path):
         """setSteps(N) must stop each node after N train steps with data
         left over (reference args.steps semantics) — the Param is consumed
-        by make_batch_iterator's max_steps, feed termination drops the rest."""
+        by make_batch_iterator's max_steps, feed termination drops the rest.
+        Doubles as the fast-gate fit→transform e2e (the uncapped variant is
+        the slow-marked test above)."""
         rows = wide_deep.synthetic_criteo(64, seed=2)
         est = pipeline.TPUEstimator(mapfuns.train_wide_deep, {"vocab_size": 1009})
         est.setNumExecutors(2).setEpochs(1).setBatchSize(8).setSteps(2)
         est.set("export_dir", str(tmp_path / "export"))
         est.set("log_dir", str(tmp_path / "logs"))
-        est.fit(PartitionedDataset.from_iterable(rows, 8))
+        model = est.fit(PartitionedDataset.from_iterable(rows, 8))
         # 64 rows / 2 nodes / bs 8 = 4 possible steps; capped at 2
         assert [m["train_steps"] for m in est.last_cluster_info] == [2, 2]
+        assert os.path.isdir(tmp_path / "export")
+        losses = [f for f in os.listdir(tmp_path / "logs") if f.startswith("loss_")]
+        assert len(losses) == 2
+        scored = model.transform(PartitionedDataset.from_iterable(rows[:12], 2))
+        out = list(scored)
+        assert len(out) == 12 and scored.num_partitions == 2
+        assert all("prediction" in r for r in out)
+        assert all(np.allclose(r["features"], rows[i]["features"])
+                   for i, r in enumerate(out))
 
     @pytest.mark.slow
     def test_fit_on_two_process_jax_distributed(self, tmp_path):
@@ -152,6 +164,7 @@ class TestFitTransform:
         with pytest.raises(KeyError, match="zz"):
             rows_to_features(rows, {"zz": "x"})
 
+    @pytest.mark.slow
     def test_transform_multi_column_mapping(self, tmp_path):
         """A two-column input_mapping must see BOTH columns (VERDICT r2 weak #6):
         split the 39 wide-and-deep features into two row columns and check the
@@ -182,6 +195,7 @@ class TestFitTransform:
         np.testing.assert_allclose([r["prediction"] for r in out], baseline,
                                    rtol=1e-5)
 
+    @pytest.mark.slow
     def test_transform_output_mapping(self, tmp_path):
         from tensorflowonspark_tpu.checkpoint import export_bundle
         import jax

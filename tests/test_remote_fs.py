@@ -16,6 +16,8 @@ import glob
 import os
 import sys
 
+import pytest
+
 import tensorflowonspark_tpu as tos
 from tensorflowonspark_tpu.utils.paths import register_fs_root, resolve_uri
 
@@ -41,6 +43,7 @@ def test_unregistered_scheme_fails_fast_with_remedy():
         resolve_uri("nosuchfs://namenode/a/b")
 
 
+@pytest.mark.slow
 def test_hopsfs_uri_end_to_end(tmp_path):
     register_fs_root("hopsfs", str(tmp_path))
     assert resolve_uri("hopsfs://nn/a/b") == str(tmp_path / "a" / "b")

@@ -3,12 +3,14 @@ inference round-trips — shakes ring/TCP framing, EndPartition bookkeeping,
 and the ordered exactly-count invariant at a partition count well above what
 the e2e tests use (reference regime: hundreds of Spark partitions)."""
 
+import pytest
 import tensorflowonspark_tpu as tos
 from tensorflowonspark_tpu.cluster import InputMode
 
 import mapfuns
 
 
+@pytest.mark.slow
 def test_many_partition_train_and_inference(tmp_path):
     # 60 uneven partitions (sizes 0..~12) x 2 epochs through 2 nodes
     items = list(range(300))

@@ -19,6 +19,7 @@ def test_writes_event_file(tmp_path):
 
 
 @pytest.mark.skipif(not HAVE_TB, reason="tensorboard not installed")
+@pytest.mark.slow
 def test_tensorboard_can_parse(tmp_path):
     from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
 
