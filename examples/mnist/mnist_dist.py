@@ -22,6 +22,66 @@ import jax
 import optax
 
 
+def _model_config(args) -> dict:
+    return {"model": "mnist_cnn", "num_classes": 10, "bf16": bool(args.get("bf16")),
+            "features": list(args.get("features", (32, 64))),
+            "dense": args.get("dense", 256)}
+
+
+def _evaluator_loop(args, ctx):
+    """The evaluator role (reference: the ``evaluator`` job in the cluster
+    template, ``TFCluster.py:~290-330``): sidecar node that periodically
+    loads the newest checkpoint, scores a held-out set, and writes eval
+    scalars.  Excluded from the data feed and from training collectives
+    (``ctx.num_data_nodes``); exits once training is done (the chief drops a
+    ``TRAINING_DONE`` marker after the final coordinated save) and the last
+    checkpoint has been evaluated — or on a driver stop signal.
+    """
+    import numpy as np
+
+    from tensorflowonspark_tpu.checkpoint import latest_step_dir, restore_checkpoint
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+    from tensorflowonspark_tpu.summary import SummaryWriter
+    from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+    model = mnist.build_mnist(_model_config(args))
+    batch = mnist.batch_to_arrays(
+        list(synthetic_mnist(args.get("eval_samples", 128),
+                             seed=args.get("eval_seed", 1))))
+    apply_fn = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    writer = (SummaryWriter(os.path.join(args["log_dir"], "eval"))
+              if args.get("log_dir") else None)
+    done_marker = os.path.join(resolve_uri(args["model_dir"]), "TRAINING_DONE")
+    interval = float(args.get("eval_interval", 10.0))
+    last_step, evals = -1, []
+    try:
+        while True:
+            # read the marker BEFORE the checkpoint listing: a marker that
+            # was already present when we saw the latest step means no newer
+            # checkpoint can appear after this evaluation
+            training_done = os.path.exists(done_marker)
+            path = latest_step_dir(args["model_dir"])
+            if path is not None:
+                step_no = int(path.rsplit("_", 1)[1])
+                if step_no > last_step:
+                    params = restore_checkpoint(path)["params"]
+                    logits = jax.device_get(apply_fn(params, batch["image"]))
+                    labels = np.asarray(batch["label"])
+                    acc = float((np.asarray(logits).argmax(-1) == labels).mean())
+                    if writer is not None:
+                        writer.add_scalar("eval/accuracy", acc, step_no)
+                    evals.append({"step": step_no, "accuracy": acc})
+                    ctx.update_meta({"evals": evals})
+                    last_step = step_no
+            if training_done or ctx.stop_requested.is_set():
+                return
+            ctx.stop_requested.wait(interval)
+    finally:
+        if writer is not None:
+            writer.close()
+
+
 def main_fun(args, ctx):
     """map_fun executed on every node (reference signature: main_fun(args, ctx))."""
     from tensorflowonspark_tpu.checkpoint import CheckpointManager, chief_save, export_bundle
@@ -29,9 +89,26 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.parallel.dp import TrainState, make_batch_iterator, make_train_step, replicate
     from tensorflowonspark_tpu.summary import SummaryWriter
 
-    model_config = {"model": "mnist_cnn", "num_classes": 10, "bf16": bool(args.get("bf16")),
-                    "features": list(args.get("features", (32, 64))),
-                    "dense": args.get("dense", 256)}
+    # A restart into the same model_dir must not leave last run's
+    # TRAINING_DONE marker behind (the evaluator would exit immediately):
+    # the chief clears it and EVERY node — evaluator included — waits on the
+    # barrier before proceeding, so the evaluator can never see a stale one.
+    if args.get("model_dir"):
+        if ctx.executor_id == 0:
+            import contextlib
+
+            from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+            os.makedirs(resolve_uri(args["model_dir"]), exist_ok=True)
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(os.path.join(resolve_uri(args["model_dir"]),
+                                       "TRAINING_DONE"))
+        ctx.barrier("marker-clear", timeout=120.0)
+
+    if ctx.job_name == "evaluator":
+        return _evaluator_loop(args, ctx)
+
+    model_config = _model_config(args)
     model = mnist.build_mnist(model_config)
     params = mnist.init_params(model, jax.random.PRNGKey(args.get("seed", 0)))
     optimizer = optax.sgd(args.get("lr", 0.05), momentum=0.9)
@@ -76,6 +153,13 @@ def main_fun(args, ctx):
 
     if manager is not None:
         chief_save(ctx, manager, int(state.step), jax.device_get(state)._asdict())
+        if is_chief:
+            # committed AFTER the final save: the evaluator exits once it
+            # has both seen this marker and scored the newest checkpoint
+            from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+            open(os.path.join(resolve_uri(args["model_dir"]),
+                              "TRAINING_DONE"), "w").close()
     if is_chief:
         if args.get("export_dir"):
             export_bundle(args["export_dir"], state.params, model_config)
@@ -120,15 +204,23 @@ def main() -> None:
     p.add_argument("--export-dir", default="/tmp/mnist_export")
     p.add_argument("--log-dir", default="/tmp/mnist_logs")
     p.add_argument("--tensorboard", action="store_true")
+    p.add_argument("--eval", action="store_true",
+                   help="add an evaluator node that periodically scores the "
+                        "latest checkpoint (one extra executor)")
+    p.add_argument("--eval-interval", type=float, default=10.0)
+    p.add_argument("--checkpoint-every", type=int, default=50)
     a = p.parse_args()
 
     args = {
         "batch_size": a.batch_size, "lr": a.lr, "model_dir": a.model_dir,
         "export_dir": a.export_dir, "log_dir": a.log_dir,
+        "eval_interval": a.eval_interval, "checkpoint_every": a.checkpoint_every,
     }
     data = tos.PartitionedDataset.from_iterable(synthetic_mnist(a.samples), a.partitions)
     cluster = tos.run(
-        main_fun, args, num_executors=a.num_executors,
+        main_fun, args,
+        num_executors=a.num_executors + (1 if a.eval else 0),
+        eval_node=a.eval,
         input_mode=tos.InputMode.STREAMING, tensorboard=a.tensorboard,
         log_dir=a.log_dir,
     )

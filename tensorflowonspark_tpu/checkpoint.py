@@ -176,7 +176,9 @@ def chief_save(ctx, manager: CheckpointManager, step: int, tree: Any,
     if ctx.executor_id == 0:
         manager.save(step, tree)
         manager.wait()
-    ctx.barrier("checkpoint", timeout=timeout)
+    # Data-node scope: the evaluator role never trains and never calls this,
+    # so an all-nodes barrier would deadlock any cluster running one.
+    ctx.barrier("checkpoint", timeout=timeout, group="data")
 
 
 # -- inference bundles (SavedModel analogue) ---------------------------------

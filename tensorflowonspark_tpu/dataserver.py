@@ -25,6 +25,7 @@ Invariants preserved:
 
 from __future__ import annotations
 
+import contextlib
 import hmac
 import hashlib
 import logging
@@ -251,6 +252,9 @@ class DataServer:
                 return ("err", f"ring unavailable: {e}")
             t = threading.Thread(target=self._serve_ring, args=(c2s, s2c),
                                  daemon=True, name="dataserver-ring")
+            # prune finished threads so repeated ring setups (driver
+            # reconnects/downgrades) don't accumulate dead Thread objects
+            self._ring_threads = [r for r in self._ring_threads if r.is_alive()]
             self._ring_threads.append(t)
             t.start()
             return ("ok", c2s.name, s2c.name)
@@ -416,8 +420,16 @@ class DataClient:
             try:
                 _send(self._sock, msg)
                 return self._check(_recv(self._sock))
+            except (TimeoutError, OSError):
+                # the stream may now hold a partial frame or a late reply;
+                # reusing it would hand a future call the WRONG response —
+                # poison the socket (mirror of _teardown_ring)
+                with contextlib.suppress(OSError):
+                    self._sock.close()
+                raise
             finally:
-                self._sock.settimeout(None)
+                with contextlib.suppress(OSError):
+                    self._sock.settimeout(None)
 
     def _teardown_ring(self) -> None:
         if self._c2s is not None:

@@ -207,9 +207,13 @@ def test_send_eof_after_server_stop_fails_fast():
 
     from tensorflowonspark_tpu import shm_ring
 
+    if not shm_ring.available():
+        # TCP-only: established connections outlive stop() by design (the
+        # node process exit closes them); the fast-fail contract under test
+        # is specific to the ring transport.
+        pytest.skip("native shm ring not buildable")
     queues, server, client = start_pair(feed_timeout=600.0)
-    if shm_ring.available():
-        assert client.using_ring
+    assert client.using_ring
     client.send_eof("input")  # healthy path works
     server.stop()
     t0 = time.monotonic()

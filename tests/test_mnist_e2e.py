@@ -100,3 +100,41 @@ def test_direct_tfrecord_train(tmp_path):
                       log_dir=str(tmp_path / "nodelogs"), reservation_timeout=120)
     cluster.shutdown(timeout=300)
     assert os.path.exists(tmp_path / "export" / "bundle.json")
+
+
+@pytest.mark.slow
+def test_evaluator_role_evaluates(tmp_path):
+    """The evaluator node must observably evaluate (VERDICT r3 item 10):
+    it loads checkpoints as the chief writes them, publishes accuracies
+    through the meta channel, writes eval scalars, and exits cleanly once
+    the chief drops the TRAINING_DONE marker — all without participating
+    in the data feed or the training consensus."""
+    import glob
+
+    from tensorflowonspark_tpu.models.mnist import synthetic_mnist
+
+    args = {**TINY, "model_dir": str(tmp_path / "model"),
+            "log_dir": str(tmp_path / "logs"),
+            "checkpoint_every": 2, "eval_interval": 0.2,
+            "eval_samples": 64}
+    data = tos.PartitionedDataset.from_iterable(synthetic_mnist(128), 4)
+    # 3 executors = chief + worker + evaluator
+    cluster = tos.run(mnist_dist.main_fun, args, num_executors=3,
+                      eval_node=True, input_mode=tos.InputMode.STREAMING,
+                      log_dir=str(tmp_path / "nodelogs"),
+                      reservation_timeout=120)
+    cluster.train(data)
+    cluster.shutdown(timeout=300)
+    metas = cluster.coordinator.cluster_info()
+    ev = next(m for m in metas if m["job_name"] == "evaluator")
+    evals = ev.get("evals")
+    assert evals, f"evaluator never evaluated: {ev}"
+    # it scored the FINAL checkpoint (written by the coordinated chief_save)
+    from tensorflowonspark_tpu.checkpoint import latest_step_dir
+
+    final_step = int(latest_step_dir(args["model_dir"]).rsplit("_", 1)[1])
+    assert evals[-1]["step"] == final_step
+    assert all(0.0 <= e["accuracy"] <= 1.0 for e in evals)
+    # eval scalars landed in their own TB event file
+    assert glob.glob(str(tmp_path / "logs" / "eval" / "events.out.tfevents.*"))
+    assert os.path.exists(tmp_path / "model" / "TRAINING_DONE")
