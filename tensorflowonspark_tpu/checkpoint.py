@@ -75,10 +75,24 @@ def restore_checkpoint(path: str, target: Any | None = None) -> Any:
     loudly rather than load garbage.
     """
     local = os.path.abspath(resolve_uri(path))
-    raw = _checkpointer().restore(local)
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    ckptr = _checkpointer()
+    # Restore to HOST numpy explicitly: the default path rebuilds the
+    # save-time shardings, which fails whenever the restoring process has a
+    # different topology than the saver — e.g. the driver reading a
+    # checkpoint written collectively by a 2-process jax.distributed mesh,
+    # or a TPU checkpoint opened on CPU.  Callers re-place the tree on
+    # their own mesh (dp.replicate / mesh.shard_tree) anyway.
+    meta = ckptr.metadata(local).item_metadata.tree
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta,
+        is_leaf=lambda x: not isinstance(x, (dict, list, tuple)))
+    raw = ckptr.restore(local, restore_args=restore_args)
     if target is None:
         return raw
-    import jax
 
     leaves = jax.tree.leaves(raw)
     treedef = jax.tree.structure(target)
@@ -164,16 +178,34 @@ class CheckpointManager:
 
 def chief_save(ctx, manager: CheckpointManager, step: int, tree: Any,
                timeout: float = 600.0) -> None:
-    """Multi-host save coordination: the chief writes, everyone barriers.
+    """Multi-host save coordination.
 
-    Correct for replicated train state (every host holds the full value;
-    N hosts writing the same bytes would race on the commit rename —
-    reference's equivalent hazard: every Spark executor writing the same
-    HDFS SavedModel path).  The barrier releases only after the chief's
-    save has *committed*, so a host that crashes right after this call
-    can still restart from the step just written.
+    Two regimes, selected automatically:
+
+    - **host-local state** (each process holds full values — pure DP
+      replication, or independent single-process meshes): the chief writes,
+      everyone barriers.  N hosts writing the same bytes would race on the
+      commit rename — reference's equivalent hazard: every Spark executor
+      writing the same HDFS SavedModel path.
+    - **multi-process global arrays** (``jax.distributed`` mesh spanning
+      hosts, e.g. FSDP/tp-sharded state): the save itself is a collective —
+      EVERY data node calls it; orbax serializes each process's addressable
+      shards and commits atomically on the primary.  A chief-only save
+      would be unable to fetch remote shards.
+
+    Either way the barrier releases only after the save has *committed*, so
+    a host that crashes right after this call can still restart from the
+    step just written.
     """
-    if ctx.executor_id == 0:
+    import jax
+
+    # Under jax.distributed ANY orbax save is a collective: orbax runs
+    # sync_global_processes over the whole jax process group internally, so
+    # a chief-only save would deadlock even for host-local numpy trees.
+    # (The evaluator is not in the jax process group — node.py initializes
+    # data nodes only — so "all jax processes" == "all data nodes" here.)
+    collective = jax.process_count() > 1
+    if collective or ctx.executor_id == 0:
         manager.save(step, tree)
         manager.wait()
     # Data-node scope: the evaluator role never trains and never calls this,

@@ -263,24 +263,38 @@ def node_main(config: NodeConfig) -> int:
         if tb_url:
             client.update_meta(executor_id, {"tb_url": tb_url})
 
-    if config.jax_distributed:
+    if config.jax_distributed and ident["job_name"] != "evaluator":
         # Real multi-host SPMD: one JAX process per host over DCN.  The chief
         # picks a free port on its own host and distributes it through a
         # control-plane max-reduce (everyone else contributes -1), so no node
         # guesses at unreserved ports (SURVEY.md §5.2 race class).
+        #
+        # DATA NODES ONLY: the evaluator is a sidecar excluded from every
+        # collective by design (consensus, barriers — and crucially orbax,
+        # whose save/restore run sync_global_processes over the WHOLE jax
+        # process group: an evaluator inside the group would deadlock every
+        # collective checkpoint save).  Role assignment puts the evaluator
+        # last, so data nodes are the contiguous ids 0..N_data-1 that
+        # jax.distributed requires.
         import jax
 
         from tensorflowonspark_tpu.utils.net import find_free_port
 
+        num_data = sum(1 for m in cluster_info if m["job_name"] != "evaluator")
         port = find_free_port() if executor_id == 0 else -1
         port = int(client.reduce("jax_coordinator_port", port, kind="max",
-                                 timeout=config.reservation_timeout))
+                                 timeout=config.reservation_timeout,
+                                 count=num_data))
         chief_host = cluster_info[0]["host"]
         jax.distributed.initialize(
             coordinator_address=f"{chief_host}:{port}",
-            num_processes=len(cluster_info),
+            num_processes=num_data,
             process_id=executor_id,
         )
+        client.update_meta(executor_id, {"device": tpu_info.device_summary()})
+    elif config.jax_distributed:
+        # evaluator in a distributed job: local backend only (lazy); report
+        # what this host exposes
         client.update_meta(executor_id, {"device": tpu_info.device_summary()})
 
     ctx = NodeContext(

@@ -195,6 +195,60 @@ def train_streaming_dist(args, ctx):
     ctx.barrier("stream-dist-done", timeout=120.0)
 
 
+def train_streaming_dist_ckpt(args, ctx):
+    """train_streaming_dist plus the full checkpoint lifecycle on a
+    multi-process global mesh: restore-or-init at start (raw host restore ->
+    process-aware placement), collective chief_save of the GLOBAL state at
+    the end (every data node participates — orbax writes each process's
+    addressable shards)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import CheckpointManager, chief_save
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    if ctx.job_name == "evaluator":
+        # sidecar: OUTSIDE the jax.distributed process group (so orbax's
+        # collective save barriers never wait on it); records that fact
+        ctx.update_meta({"eval_process_count": jax.process_count()})
+        return
+
+    mesh = ctx.make_mesh(dp=-1)
+    optimizer = optax.sgd(0.1)
+    manager = CheckpointManager(args["model_dir"])
+    host_state = dplib.TrainState.create(
+        {"w": np.full((4, 1), 0.5, np.float32)}, optimizer)
+    restored = manager.restore_latest(host_state._asdict())
+    if restored is not None:
+        host_state = dplib.TrainState(**restored[0])
+    state = dplib.replicate(host_state, mesh)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2), {}
+
+    step = dplib.make_train_step(loss_fn, optimizer)
+
+    def to_arrays(items):
+        return {"x": np.stack([np.asarray(i[0], np.float32) for i in items]),
+                "y": np.asarray([i[1] for i in items], np.float32)}
+
+    feed = ctx.get_data_feed(train_mode=True)
+    losses = []
+    for batch, _n in dplib.make_batch_iterator(
+            feed, int(args["batch_size"]), to_arrays, mesh=mesh, ctx=ctx):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    chief_save(ctx, manager, int(jax.device_get(state.step)), state._asdict())
+    ctx.update_meta({"ckpt_dist": {
+        "losses": losses,
+        "final_step": int(jax.device_get(state.step)),
+        "final_w": np.asarray(jax.device_get(state.params["w"])).ravel().tolist(),
+    }})
+
+
 def hangs_forever(args, ctx):
     """Ignores EOF and stop signals (zombie teardown probe)."""
     while True:
