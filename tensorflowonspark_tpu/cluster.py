@@ -329,6 +329,20 @@ class TPUCluster:
 
     # -- observability (reference TFCluster.tensorboard_url :~240-260) -------
 
+    def chip_plan(self):
+        """Authoritative global chip numbering across the registered nodes
+        (``tpu_info.plan_topology`` over each node's reported
+        ``device_summary``, in executor-id order) — the driver-side
+        replacement for the reference's per-executor randomized GPU picking
+        (``gpu_info.py``; SURVEY.md §5.2 disposition).  Returns one
+        ``HostAssignment`` per node; evaluators report their chips too but
+        own no data-plane role."""
+        from tensorflowonspark_tpu import tpu_info
+
+        counts = [int((m.get("device") or {}).get("num_devices") or 0)
+                  for m in self.coordinator.cluster_info()]
+        return tpu_info.plan_topology(counts)
+
     def tensorboard_url(self) -> str | None:
         for meta in self.coordinator.cluster_info():
             if "tb_url" in meta:

@@ -129,14 +129,18 @@ def from_example(buf: bytes, schema: Schema | None = None, binary_features: set 
     return row
 
 
-def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema | None = None) -> Schema:
+def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema | None = None,
+                      compression: str | None = None) -> Schema:
     """Write one TFRecord shard per partition (reference ``saveAsTFRecords``,
-    ``dfutil.py:~30-60``); stores the schema alongside as ``_schema.json``."""
+    ``dfutil.py:~30-60``); stores the schema alongside as ``_schema.json``.
+    ``compression='gzip'`` writes TF-compatible gzipped shards (``.gz``
+    suffix; readers auto-detect)."""
     output_dir = resolve_uri(output_dir)
     os.makedirs(output_dir, exist_ok=True)
+    suffix = ".gz" if compression and compression.lower() == "gzip" else ""
     for p in range(data.num_partitions):
-        path = os.path.join(output_dir, f"part-r-{p:05d}")
-        with tfrecord.RecordWriter(path) as w:
+        path = os.path.join(output_dir, f"part-r-{p:05d}{suffix}")
+        with tfrecord.RecordWriter(path, compression=compression) as w:
             for row in data.iter_partition(p):
                 if schema is None:
                     schema = infer_schema(row)

@@ -288,7 +288,8 @@ def pad_batch(token_lists, seq_len: int, pad_id: int = 0):
 
 def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
                     max_decode_len: int = 0, temperature: float = 0.0,
-                    top_k: int = 0, seed: int = 0):
+                    top_k: int = 0, seed: int = 0,
+                    eos_id: int | None = None, pad_id: int = 0):
     """Autoregressive decoding through the static KV cache.
 
     ``prompt_ids: [B, S] int32`` → ``[B, S + max_new_tokens]``.  Serving
@@ -301,6 +302,12 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
     ``temperature == 0`` (default) is greedy argmax; ``> 0`` samples from
     ``softmax(logits / temperature)``, optionally truncated to the
     ``top_k`` most likely tokens.  Sampling is deterministic under ``seed``.
+
+    ``eos_id`` enables early stopping: a row that emits it keeps its EOS and
+    produces ``pad_id`` from then on, and the loop exits once EVERY row has
+    finished (possibly before ``max_new_tokens``, so the returned width
+    varies).  The per-row masking happens host-side between steps — the
+    compiled decode step itself stays batch-static, so no recompiles.
     """
     import numpy as np
 
@@ -340,11 +347,18 @@ def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
     # (one [B,S] prefill program + one [B,1] decode program) instead of the
     # O(S) sequential single-token steps of the naive loop.
     cache, logits = step(params, cache, jnp.asarray(prompt_ids, jnp.int32))
+    finished = np.zeros((b,), bool)
     for _ in range(max_new_tokens):
         key, sub = jax.random.split(key)
-        nxt = pick(logits, sub)
-        tokens.append(np.asarray(nxt))
-        cache, logits = step(params, cache, nxt[:, None])
+        nxt = np.asarray(pick(logits, sub))
+        if eos_id is not None:
+            nxt = np.where(finished, pad_id, nxt)
+        tokens.append(nxt)
+        if eos_id is not None:
+            finished |= nxt == eos_id
+            if finished.all():
+                break
+        cache, logits = step(params, cache, jnp.asarray(nxt[:, None]))
     return np.stack(tokens, axis=1)
 
 

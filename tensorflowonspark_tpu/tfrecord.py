@@ -90,10 +90,29 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
 
     With the native codec, the whole shard is scanned in C++ (one CRC pass,
     no per-record Python framing work); otherwise a streaming Python parser.
+
+    GZIP-compressed shards (TF's ``TFRecordOptions('GZIP')`` format — the
+    whole stream gzipped; the reference's Hadoop TFRecord input supported
+    the same) are detected by magic bytes and decompressed transparently.
     """
+    import gzip
+
+    # Detection must not misread a PLAIN shard whose first record length
+    # happens to collide with the gzip magic (the header starts with a
+    # little-endian uint64 length, so 0x1f 0x8b is reachable): beyond the
+    # 3-byte gzip signature, prefer the plain interpretation whenever the
+    # header's own masked length-CRC validates — a ~2^-32 discriminator.
+    with open(path, "rb") as probe:
+        head = probe.read(12)
+    is_gzip = len(head) >= 3 and head[:3] == b"\x1f\x8b\x08"
+    if is_gzip and len(head) == 12 and \
+            masked_crc32c(head[:8]) == _U32.unpack_from(head, 8)[0]:
+        is_gzip = False
     if _native is not None:
         with open(path, "rb") as f:
             buf = f.read()
+        if is_gzip:
+            buf = gzip.decompress(buf)
         try:
             spans, consumed = _native.scan_records(buf, verify)
         except ValueError as e:
@@ -103,7 +122,7 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
         for off, length in spans:
             yield buf[off : off + length]
         return
-    with open(path, "rb") as f:
+    with (gzip.open(path, "rb") if is_gzip else open(path, "rb")) as f:
         offset = 0
         while True:
             hdr = f.read(12)
@@ -126,11 +145,27 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
 
 
 class RecordWriter:
-    """Streaming TFRecord writer."""
+    """Streaming TFRecord writer.
 
-    def __init__(self, path: str):
+    ``compression='gzip'`` (or a ``.gz`` path suffix) writes the
+    TF-compatible whole-stream-gzipped form; ``read_records`` auto-detects
+    it on the way back.
+    """
+
+    def __init__(self, path: str, compression: str | None = None):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, "wb")
+        if compression is None and path.endswith(".gz"):
+            compression = "gzip"
+        normalized = (compression or "none").lower()
+        if normalized in ("", "none"):
+            self._f = open(path, "wb")
+        elif normalized == "gzip":
+            import gzip
+
+            self._f = gzip.open(path, "wb")
+        else:
+            raise ValueError(f"unsupported compression {compression!r}; "
+                             "use None or 'gzip'")
 
     def write(self, data: bytes) -> None:
         self._f.write(frame_record(data))
@@ -148,10 +183,11 @@ class RecordWriter:
         self.close()
 
 
-def write_records(path: str, records: Iterable[bytes]) -> int:
+def write_records(path: str, records: Iterable[bytes],
+                  compression: str | None = None) -> int:
     """Write all records to one file; returns the record count."""
     n = 0
-    with RecordWriter(path) as w:
+    with RecordWriter(path, compression=compression) as w:
         for rec in records:
             w.write(rec)
             n += 1

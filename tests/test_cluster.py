@@ -21,6 +21,13 @@ def test_run_and_shutdown_noop():
     cluster = tos.run(mapfuns.noop, num_executors=2, reservation_timeout=60)
     assert len(cluster.cluster_info) == 2
     assert cluster.cluster_info[0]["job_name"] == "chief"
+    # driver-side authoritative chip numbering from registered device facts
+    plan = cluster.chip_plan()
+    assert [a.executor_id for a in plan] == [0, 1]
+    counts = [(m.get("device") or {}).get("num_devices") or 0
+              for m in cluster.cluster_info]
+    assert [a.num_chips for a in plan] == [int(c) for c in counts]
+    assert plan[1].chip_start == plan[0].num_chips  # disjoint, contiguous
     cluster.shutdown()
 
 

@@ -73,3 +73,15 @@ def test_empty_dataset_raises(tmp_path):
     ds = PartitionedDataset.from_iterable([], 1)
     with pytest.raises(ValueError, match="empty"):
         dfutil.save_as_tfrecords(ds, str(tmp_path / "e"))
+
+
+def test_save_load_gzip_shards(tmp_path):
+    rows = [{"x": [float(i), i + 0.5], "label": i % 3} for i in range(12)]
+    data = PartitionedDataset.from_iterable(rows, 3)
+    dfutil.save_as_tfrecords(data, str(tmp_path / "gz"), compression="gzip")
+    shards = dfutil.shard_files(str(tmp_path / "gz"))
+    assert len(shards) == 3 and all(s.endswith(".gz") for s in shards)
+    schema = dfutil.read_schema(str(tmp_path / "gz"))
+    back = [row for s in shards for row in dfutil.read_shard(s, schema)]
+    assert len(back) == 12
+    assert back[0]["x"] == [0.0, 0.5] and back[11]["label"] == 2

@@ -146,6 +146,29 @@ def test_pad_batch_masks_padding_out_of_loss(lm):
     np.testing.assert_allclose(float(repoisoned), float(masked), rtol=1e-6)
 
 
+def test_eos_early_stop_pads_and_truncates(lm):
+    """eos_id: rows keep their EOS, emit pad_id afterwards, and the loop can
+    end before max_new_tokens once every row finished; pre-EOS tokens are
+    identical to the unconstrained greedy chain (per-row masking must not
+    disturb other rows' decoding)."""
+    model, ids, params = lm
+    prompt = ids[:, :3]
+    base = tfm.greedy_generate(model, params, prompt, max_new_tokens=6)
+    eos = int(base[0, 3])  # force row 0 to "finish" at its first new token
+    out = tfm.greedy_generate(model, params, prompt, max_new_tokens=6,
+                              eos_id=eos, pad_id=0,
+                              max_decode_len=prompt.shape[1] + 6)
+    assert out.shape[1] <= base.shape[1]
+    for r in range(out.shape[0]):
+        gen = out[r, 3:]
+        hits = np.where(gen == eos)[0]
+        end = hits[0] + 1 if len(hits) else len(gen)
+        np.testing.assert_array_equal(gen[:end], base[r, 3 : 3 + end])
+        assert (gen[end:] == 0).all()
+    # row 0 finished immediately
+    assert out[0, 3] == eos and (out[0, 4:] == 0).all()
+
+
 def test_sampled_generation_valid_and_deterministic(lm):
     model, ids, params = lm
     prompt = ids[:, :3]

@@ -108,3 +108,58 @@ def test_tfrecord_file_readable_by_tensorflow(tmp_path):
     with tf.io.TFRecordWriter(tf_path) as w:
         w.write(b"three")
     assert list(tfrecord.read_records(tf_path)) == [b"three"]
+
+
+def test_gzip_roundtrip_and_autodetect(tmp_path):
+    """TF's GZIP TFRecord form (whole stream gzipped): explicit compression
+    kwarg or a .gz suffix on write; reads auto-detect by magic bytes through
+    both the native-codec and pure-Python paths."""
+    import gzip
+
+    recs = [f"payload-{i}".encode() * (i + 1) for i in range(20)]
+    p1 = str(tmp_path / "explicit.tfrecord")
+    tfrecord.write_records(p1, recs, compression="gzip")
+    with open(p1, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"  # really gzipped on disk
+    assert list(tfrecord.read_records(p1)) == recs
+
+    p2 = str(tmp_path / "suffix.tfrecord.gz")
+    tfrecord.write_records(p2, recs)  # .gz suffix implies gzip
+    with open(p2, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"
+    assert list(tfrecord.read_records(p2)) == recs
+
+    # interop both directions: a plain file written earlier still reads, and
+    # the gzipped payload equals the uncompressed framing byte-for-byte
+    p3 = str(tmp_path / "plain.tfrecord")
+    tfrecord.write_records(p3, recs)
+    with open(p3, "rb") as f:
+        plain = f.read()
+    with gzip.open(p1, "rb") as f:
+        assert f.read() == plain
+
+    with pytest.raises(ValueError, match="unsupported compression"):
+        tfrecord.RecordWriter(str(tmp_path / "x"), compression="zstd")
+
+
+def test_gzip_magic_collision_not_misdetected(tmp_path):
+    """A PLAIN shard whose first record length collides with the gzip magic
+    (little-endian 0x088b1f = 559,903 bytes) must still read as plain: the
+    header's length-CRC disambiguates."""
+    p = str(tmp_path / "collision.tfrecord")
+    payload = b"z" * 0x088B1F
+    tfrecord.write_records(p, [payload, b"tail"])
+    with open(p, "rb") as f:
+        assert f.read(3) == b"\x1f\x8b\x08"  # really starts like gzip
+    got = list(tfrecord.read_records(p))
+    assert len(got) == 2 and got[0] == payload and got[1] == b"tail"
+
+
+def test_compression_name_normalization(tmp_path):
+    for name in ("GZIP", "Gzip"):
+        p = str(tmp_path / f"{name}.tfr")
+        tfrecord.write_records(p, [b"a"], compression=name)
+        assert list(tfrecord.read_records(p)) == [b"a"]
+    p2 = str(tmp_path / "plain.tfr")
+    tfrecord.write_records(p2, [b"b"], compression="NONE")
+    assert list(tfrecord.read_records(p2)) == [b"b"]
