@@ -339,8 +339,19 @@ class TPUCluster:
         own no data-plane role."""
         from tensorflowonspark_tpu import tpu_info
 
+        infos = self.coordinator.cluster_info()
+        pending = [m["executor_id"] for m in infos
+                   if (m.get("device") or {}).get("num_devices") is None]
+        if pending:
+            # jax_distributed nodes register a placeholder and report real
+            # device facts only after jax.distributed.initialize — a plan
+            # built from placeholders would be silently all-zero
+            raise RuntimeError(
+                f"chip plan unavailable: nodes {pending} have not reported "
+                "device facts yet (distributed nodes report after their "
+                "jax.distributed bootstrap); retry once the job is running")
         counts = [int((m.get("device") or {}).get("num_devices") or 0)
-                  for m in self.coordinator.cluster_info()]
+                  for m in infos]
         return tpu_info.plan_topology(counts)
 
     def tensorboard_url(self) -> str | None:

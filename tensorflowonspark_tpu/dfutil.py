@@ -137,6 +137,12 @@ def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema 
     suffix; readers auto-detect)."""
     output_dir = resolve_uri(output_dir)
     os.makedirs(output_dir, exist_ok=True)
+    # Clobber semantics: a re-save replaces the directory's shard set.  With
+    # compression the shard NAMES change (.gz suffix), so stale shards from
+    # a previous save must be removed or shard_files() would return both
+    # generations and every row would load twice.
+    for stale in _glob.glob(os.path.join(output_dir, "part-*")):
+        os.remove(stale)
     suffix = ".gz" if compression and compression.lower() == "gzip" else ""
     for p in range(data.num_partitions):
         path = os.path.join(output_dir, f"part-r-{p:05d}{suffix}")

@@ -85,3 +85,15 @@ def test_save_load_gzip_shards(tmp_path):
     back = [row for s in shards for row in dfutil.read_shard(s, schema)]
     assert len(back) == 12
     assert back[0]["x"] == [0.0, 0.5] and back[11]["label"] == 2
+
+
+def test_resave_with_different_compression_clobbers(tmp_path):
+    rows = [{"x": [1.0], "label": 1} for _ in range(4)]
+    data = PartitionedDataset.from_iterable(rows, 2)
+    dfutil.save_as_tfrecords(data, str(tmp_path / "d"))
+    dfutil.save_as_tfrecords(data, str(tmp_path / "d"), compression="gzip")
+    shards = dfutil.shard_files(str(tmp_path / "d"))
+    assert len(shards) == 2 and all(s.endswith(".gz") for s in shards)
+    schema = dfutil.read_schema(str(tmp_path / "d"))
+    back = [r for s in shards for r in dfutil.read_shard(s, schema)]
+    assert len(back) == 4  # no duplicated generations

@@ -163,3 +163,13 @@ def test_compression_name_normalization(tmp_path):
     p2 = str(tmp_path / "plain.tfr")
     tfrecord.write_records(p2, [b"b"], compression="NONE")
     assert list(tfrecord.read_records(p2)) == [b"b"]
+
+
+def test_gzip_pure_python_path(tmp_path, monkeypatch):
+    """Exercise the no-native-codec gzip branch explicitly (a source install
+    without the C++ extension must read gzipped shards too)."""
+    recs = [b"alpha", b"beta" * 100]
+    p = str(tmp_path / "s.tfrecord.gz")
+    tfrecord.write_records(p, recs)
+    monkeypatch.setattr(tfrecord, "_native", None)
+    assert list(tfrecord.read_records(p)) == recs
