@@ -149,7 +149,7 @@ class TPUCluster:
     # -- inference (reference TFCluster.inference :~130-170, §3.3) -----------
 
     def inference(self, data: Any, qname_in: str = "input", qname_out: str = "output",
-                  flat: bool = True) -> list:
+                  flat: bool = True, eof_when_done: bool = False) -> list:
         """Round-trip partitions through the nodes; ordered, exactly-count.
 
         Returns the flattened results in partition order — the invariant the
@@ -163,14 +163,16 @@ class TPUCluster:
         dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
         results: list[list | None] = [None] * dataset.num_partitions
         for p, part in self.inference_stream(dataset, qname_in, qname_out,
-                                             window=dataset.num_partitions + 1):
+                                             window=dataset.num_partitions + 1,
+                                             eof_when_done=eof_when_done):
             results[p] = part
         if not flat:
             return [part or [] for part in results]
         return [item for part in results for item in (part or [])]
 
     def inference_stream(self, data: Any, qname_in: str = "input",
-                         qname_out: str = "output", window: int | None = None):
+                         qname_out: str = "output", window: int | None = None,
+                         eof_when_done: bool = False):
         """Lazily yield ``(partition_index, results)`` in partition order.
 
         Restores the reference's lazy-RDD property
@@ -178,6 +180,16 @@ class TPUCluster:
         incrementally, so driver memory holds at most ``window`` completed
         partitions (default ``2 × feedable nodes``) — workers pause instead
         of running ahead of the consumer.
+
+        ``eof_when_done=True`` sends end-of-feed to each node as soon as its
+        share of partitions has been dispatched AND collected (instead of at
+        shutdown).  REQUIRED for global-mesh scoring map_funs
+        (``inference.sharded_bundle_inference_loop``): there, a node whose
+        share ran out must learn it is done WHILE the driver is still
+        collecting from its peers — its end-of-data consensus votes (and
+        filler SPMD rounds) are what let the peers' remaining batches
+        execute.  Leave False for task-parallel loops that should keep
+        serving across multiple inference calls on one cluster.
         """
         if self.input_mode != InputMode.STREAMING:
             raise RuntimeError(
@@ -206,6 +218,8 @@ class TPUCluster:
                     with cond:
                         buf[p] = part
                         cond.notify_all()
+                if eof_when_done:
+                    client.send_eof(qname_in)
             except Exception as e:
                 with cond:
                     errors.append(e)

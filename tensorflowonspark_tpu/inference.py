@@ -56,6 +56,89 @@ def rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
     return np.stack([np.asarray(r, np.float32) for r in rows])
 
 
+def _local_rows(arr) -> np.ndarray:
+    """This process's rows of a batch-sharded global array, in order.
+
+    The output of an SPMD forward keeps the batch-dim sharding of its input,
+    so the addressable shards on this process are exactly the rows this
+    host's feed contributed (contiguous, ``make_array_from_process_local_data``
+    layout); concatenating them in index order reconstructs the local batch.
+    Shards are deduplicated by batch offset: non-batch mesh axes (tp, ...)
+    replicate each batch block across several devices, and concatenating
+    every copy would silently duplicate rows.
+    """
+    unique = {}
+    for s in arr.addressable_shards:
+        unique.setdefault(s.index[0].start or 0, s)
+    return np.concatenate(
+        [np.asarray(unique[k].data) for k in sorted(unique)], axis=0)
+
+
+def sharded_bundle_inference_loop(args, ctx) -> None:
+    """Model-parallel STREAMING inference (beyond-reference capability).
+
+    ``bundle_inference_loop`` is task-parallel: every node holds the whole
+    model and scores its own partitions independently — the reference's only
+    mode.  This variant serves models too large (or too sharded) for that:
+    the data nodes form ONE mesh (single- or multi-process via
+    ``jax_distributed``), params are sharded over it, every global step is
+    one SPMD forward over the assembled global batch, and each host emits
+    predictions for its OWN streamed rows only (extracted from its
+    addressable output shards), preserving the ordered exactly-count
+    contract end-to-end.
+
+    Args: ``export_dir`` (bundle), ``batch_size`` (PER-HOST), optional
+    ``mesh_axes`` (default ``{"fsdp": -1}`` — params sharded over every
+    device; pass e.g. ``{"dp": 2, "fsdp": 2}`` to trade replication for
+    bandwidth), ``postprocess``/``input_mapping`` as in
+    ``bundle_inference_loop``.
+
+    Driver contract: call ``cluster.inference(..., eof_when_done=True)`` —
+    a host whose share of partitions ran out must learn it is done while
+    peers are still scoring (its consensus votes/filler rounds gate their
+    SPMD steps) — and give every data node at least one partition.
+    """
+    import jax
+
+    from tensorflowonspark_tpu.checkpoint import load_bundle_cached
+    from tensorflowonspark_tpu.models.registry import build_apply
+    from tensorflowonspark_tpu.parallel import dp as dplib
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+
+    export_dir = _arg(args, "export_dir")
+    if not export_dir:
+        raise ValueError("sharded_bundle_inference_loop requires args.export_dir")
+    batch_size = int(_arg(args, "batch_size", 64) or 64)
+    postprocess = _arg(args, "postprocess")
+    input_mapping = _arg(args, "input_mapping")
+    mesh_axes = dict(_arg(args, "mesh_axes") or {"fsdp": -1})
+
+    variables, _config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    mesh = ctx.make_mesh(**mesh_axes)
+    gvars = meshlib.shard_tree(mesh, variables)  # fsdp-sharded; small leaves replicated
+
+    def scored(v, x):
+        out = apply_fn(v, x)
+        # pin the batch-dim sharding: a replicated output would make every
+        # host read the whole global batch and emit the WRONG rows
+        return jax.lax.with_sharding_constraint(
+            out, meshlib.batch_sharding(mesh, extra_dims=out.ndim - 1))
+
+    jit_scored = jax.jit(scored)
+    feed = ctx.get_data_feed(train_mode=False)
+    for batch, n in dplib.make_batch_iterator(
+            feed, batch_size, lambda items: rows_to_features(items, input_mapping),
+            mesh=mesh, ctx=ctx):
+        out = jit_scored(gvars, batch)
+        if not n:
+            continue  # filler round: joined the collective, nothing to emit
+        preds = _local_rows(out)[:n]
+        if postprocess == "argmax":
+            feed.batch_results([int(p) for p in preds.argmax(axis=-1)])
+        else:
+            feed.batch_results(list(preds))
+
+
 def bundle_inference_loop(args, ctx) -> None:
     """map_fun: score the stream with the bundle at ``args.export_dir``.
 

@@ -236,11 +236,18 @@ def train_streaming_dist_ckpt(args, ctx):
                 "y": np.asarray([i[1] for i in items], np.float32)}
 
     feed = ctx.get_data_feed(train_mode=True)
+    ckpt_every = int(args.get("checkpoint_every", 0) or 0)
     losses = []
     for batch, _n in dplib.make_batch_iterator(
             feed, int(args["batch_size"]), to_arrays, mesh=mesh, ctx=ctx):
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
+        step_no = int(jax.device_get(state.step))
+        # Mid-loop COLLECTIVE saves are safe under multi-process streaming:
+        # the batch iterator keeps every host's global-step count in
+        # lockstep, so all data nodes reach this save at the same step.
+        if ckpt_every and step_no % ckpt_every == 0:
+            chief_save(ctx, manager, step_no, state._asdict())
     chief_save(ctx, manager, int(jax.device_get(state.step)), state._asdict())
     ctx.update_meta({"ckpt_dist": {
         "losses": losses,

@@ -281,7 +281,8 @@ def test_two_process_streaming_checkpoint_and_resume(tmp_path):
     def run_once(logdir):
         cluster = tcluster.run(
             mapfuns.train_streaming_dist_ckpt,
-            {"batch_size": bs, "model_dir": str(tmp_path / "model")},
+            {"batch_size": bs, "model_dir": str(tmp_path / "model"),
+             "checkpoint_every": 1},
             num_executors=2,
             input_mode=tcluster.InputMode.STREAMING,
             launcher=SubprocessLauncher(),
@@ -297,6 +298,11 @@ def test_two_process_streaming_checkpoint_and_resume(tmp_path):
 
     infos = run_once("logs1")
     assert infos[0]["final_step"] == infos[1]["final_step"] == 2
+    # mid-loop collective saves landed too (lockstep makes them safe):
+    # steps 1 and 2 both committed
+    import os as _os
+
+    assert sorted(_os.listdir(tmp_path / "model")) == ["step_1", "step_2"]
     # the committed checkpoint is readable driver-side and matches the
     # state both hosts reported
     path = latest_step_dir(str(tmp_path / "model"))
@@ -309,6 +315,53 @@ def test_two_process_streaming_checkpoint_and_resume(tmp_path):
     infos2 = run_once("logs2")
     assert infos2[0]["final_step"] == 4
     assert infos2[0]["losses"][0] != infos[0]["losses"][0]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_streaming_inference(tmp_path):
+    """Model-parallel streaming inference: params fsdp-sharded over a
+    2-process global mesh, driver-streamed partitions scored by ONE SPMD
+    forward per round, each host emitting only its own rows — ordered
+    exactly-count results identical to local scoring.  Uneven partitions
+    (5 over 2 workers) force filler rounds on the drier host."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import inference as tinfer
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.data import PartitionedDataset
+    from tensorflowonspark_tpu.models import wide_deep
+    from tensorflowonspark_tpu.models.registry import build_apply
+
+    config = {"model": "wide_deep", "vocab_size": 101, "embed_dim": 4,
+              "hidden": (8,), "bf16": False}
+    model = wide_deep.build_wide_deep(config)
+    params = wide_deep.init_params(model, jax.random.PRNGKey(0))
+    export_bundle(str(tmp_path / "b"), jax.device_get(params), config)
+
+    rows = wide_deep.synthetic_criteo(24, seed=5)
+    feats = tinfer.rows_to_features(rows, None)
+    expected = np.asarray(build_apply(config)(jax.device_get(params), feats))
+
+    env = tpu_info.chip_visibility_env((), platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        tinfer.sharded_bundle_inference_loop,
+        {"export_dir": str(tmp_path / "b"), "batch_size": 4,
+         "mesh_axes": {"fsdp": -1}},
+        num_executors=2,
+        input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(),
+        env=env,
+        jax_distributed=True,
+        log_dir=str(tmp_path / "logs"),
+        reservation_timeout=180.0,
+    )
+    results = cluster.inference(PartitionedDataset.from_iterable(rows, 5),
+                                eof_when_done=True)
+    cluster.shutdown(timeout=300.0)
+    assert len(results) == 24
+    np.testing.assert_allclose(np.stack(results), expected,
+                               rtol=1e-4, atol=1e-5)
 
 
 @pytest.mark.slow

@@ -214,3 +214,19 @@ class TestFitTransform:
         out = list(m.transform(PartitionedDataset.from_iterable(rows, 1)))
         assert len(out) == 5
         assert all("score" in r for r in out)
+
+
+def test_local_rows_dedupes_replicated_mesh_axes():
+    """inference._local_rows must not duplicate rows when non-batch mesh
+    axes (tp, ...) replicate each batch block across several devices."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import inference as tinfer
+    from tensorflowonspark_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(dp=4, tp=2)
+    x = jnp.arange(8.0)[:, None] * jnp.ones((1, 3))
+    arr = jax.device_put(x, meshlib.batch_sharding(mesh, extra_dims=1))
+    got = tinfer._local_rows(arr)
+    np.testing.assert_array_equal(got, np.asarray(x))
