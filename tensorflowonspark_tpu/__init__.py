@@ -22,7 +22,7 @@ A brand-new framework with the capabilities of TensorFlowOnSpark
 See SURVEY.md for the reference layer map this package mirrors.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
 from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
