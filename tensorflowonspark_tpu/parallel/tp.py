@@ -77,11 +77,19 @@ def compose_fsdp(mesh: Mesh, tree, shardings):
 
 def constrain(x, spec: P):
     """Activation sharding hint; no-op when no mesh context is active (so
-    models run unchanged on a bare single device / in unit tests)."""
+    models run unchanged on a bare single device / in unit tests).
+
+    Axes that are in MANUAL mode — i.e. we are inside a ``shard_map`` body,
+    e.g. a transformer Block running as a GPipe pipeline stage — are dropped
+    from the spec: per-device code already sees local shards, and
+    ``with_sharding_constraint`` rejects Manual axes outright.
+    """
     mesh = jax.sharding.get_abstract_mesh()
     if mesh.empty:
         return x
-    known = set(mesh.axis_names)
+    known = set(mesh.axis_names) - set(mesh.manual_axes)
+    if not known:
+        return x
     clean = P(*(
         (tuple(a for a in s if a in known) or None)
         if isinstance(s, tuple) else (s if s in known else None)
