@@ -188,6 +188,16 @@ class HasJaxDistributed(Params):
                             "over the cluster (global mesh spanning nodes)")
 
 
+class HasScoring(Params):
+    scoring = Param("scoring", "task",
+                    "transform execution mode: 'task' (every node holds the "
+                    "whole model, scores its own partitions) or 'sharded' "
+                    "(model sharded over one global mesh, SPMD scoring)")
+    mesh_axes = Param("mesh_axes", None,
+                      "mesh layout for scoring='sharded' "
+                      "(default {'fsdp': -1})")
+
+
 class Namespace:
     """Attribute-style argv bag (reference ``Namespace``, pipeline.py:~300-380).
 
@@ -236,7 +246,7 @@ class TPUParams(HasBatchSize, HasEpochs, HasSteps, HasInputMapping,
                 HasOutputMapping, HasInputMode, HasMasterNode, HasNumExecutors,
                 HasModelDir, HasExportDir, HasTFRecordDir, HasTensorboard,
                 HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout,
-                HasShuffleSeed, HasJaxDistributed):
+                HasShuffleSeed, HasJaxDistributed, HasScoring):
     """All framework params in one mixin stack (reference ``TFParams``)."""
 
     def merge_args_params(self, tf_args: Any = None) -> Namespace:
@@ -367,7 +377,10 @@ class TPUModel(TPUParams):
         ``output_mapping`` {model output → column} names prediction columns
         (default: {"prediction": "prediction"}).
         """
-        from tensorflowonspark_tpu.inference import bundle_inference_loop
+        from tensorflowonspark_tpu.inference import (
+            bundle_inference_loop,
+            sharded_bundle_inference_loop,
+        )
 
         args = self.merge_args_params(self.tf_args)
         export_dir = args.get("export_dir")
@@ -376,8 +389,17 @@ class TPUModel(TPUParams):
         num_executors = max(1, int(args.get("num_executors") or 1))
         data = as_partitioned(dataset, default_partitions=num_executors)
         output_mapping = args.get("output_mapping") or {"prediction": "prediction"}
+        scoring = args.get("scoring") or "task"
+        if scoring not in ("task", "sharded"):
+            raise ValueError(f"unknown scoring mode {scoring!r}; "
+                             "use 'task' or 'sharded'")
+        sharded = scoring == "sharded"
+        if sharded and data.num_partitions < num_executors:
+            raise ValueError(
+                f"scoring='sharded' needs at least one partition per node "
+                f"({data.num_partitions} partitions < {num_executors} nodes)")
         cluster = _cluster.run(
-            bundle_inference_loop,
+            sharded_bundle_inference_loop if sharded else bundle_inference_loop,
             args,
             num_executors=num_executors,
             input_mode=InputMode.STREAMING,
@@ -389,7 +411,10 @@ class TPUModel(TPUParams):
             jax_distributed=bool(args.get("jax_distributed")),
         )
         try:
-            pred_parts = cluster.inference(data, flat=False)
+            # sharded scoring REQUIRES eager EOF: a node whose share ran out
+            # keeps joining the global SPMD rounds until its peers finish
+            pred_parts = cluster.inference(data, flat=False,
+                                           eof_when_done=sharded)
         finally:
             cluster.shutdown()
         parts = []

@@ -318,6 +318,49 @@ def test_two_process_streaming_checkpoint_and_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_distributed_node_death_surfaces_bounded_error(tmp_path):
+    """Failure detection in the defining mode (SURVEY §5.3): killing one
+    process of a 2-process jax.distributed STREAMING job must surface as a
+    driver-side RuntimeError within a bounded time — never a silent hang.
+    The surviving peer may be wedged inside a gloo collective; the
+    escalating shutdown (stop signal -> SIGTERM -> kill) must still reclaim
+    it and report the abnormal exits."""
+    import threading
+    import time
+
+    from tests import mapfuns
+
+    bs = 4
+    parts = _linreg_partitions(num_partitions=40, rows_per_partition=bs)
+    env = tpu_info.chip_visibility_env((), platform="cpu", simulate_chips=2)
+    cluster = tcluster.run(
+        mapfuns.train_streaming_dist,
+        {"batch_size": bs},
+        num_executors=2,
+        input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(),
+        env=env,
+        jax_distributed=True,
+        log_dir=str(tmp_path),
+        reservation_timeout=180.0,
+    )
+    victim = cluster.launcher.processes[1]
+    threading.Timer(3.0, victim.terminate).start()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        cluster.train(parts, num_epochs=1)
+        cluster.shutdown(timeout=30.0)
+    # bounded: feeding error or escalated shutdown, not a wedge
+    assert time.monotonic() - t0 < 240.0
+    # reclaim whatever is left; errors already surfaced above
+    try:
+        cluster.shutdown(timeout=15.0)
+    except RuntimeError:
+        pass
+    assert not cluster.launcher.alive()
+
+
+@pytest.mark.slow
 def test_two_process_sharded_streaming_inference(tmp_path):
     """Model-parallel streaming inference: params fsdp-sharded over a
     2-process global mesh, driver-streamed partitions scored by ONE SPMD

@@ -143,6 +143,48 @@ class TestFitTransform:
         assert len(out) == 10
         assert all("prediction" in r for r in out)
 
+    @pytest.mark.slow
+    def test_transform_sharded_scoring_two_process(self, tmp_path):
+        """setScoring('sharded') routes transform through the global-mesh
+        SPMD scorer (model fsdp-sharded over a 2-process jax.distributed
+        mesh) with identical predictions to local scoring."""
+        import jax
+
+        from tensorflowonspark_tpu import tpu_info
+        from tensorflowonspark_tpu.checkpoint import export_bundle
+        from tensorflowonspark_tpu.inference import rows_to_features
+        from tensorflowonspark_tpu.launcher import SubprocessLauncher
+        from tensorflowonspark_tpu.models.registry import build_apply
+
+        config = {"model": "wide_deep", "vocab_size": 101, "embed_dim": 4,
+                  "hidden": (8,), "bf16": False}
+        model = wide_deep.build_wide_deep(config)
+        params = wide_deep.init_params(model, jax.random.PRNGKey(0))
+        export_bundle(str(tmp_path / "b"), jax.device_get(params), config)
+        rows = wide_deep.synthetic_criteo(16, seed=6)
+        expected = np.asarray(build_apply(config)(
+            jax.device_get(params), rows_to_features(rows, None)))
+
+        m = pipeline.TPUModel(
+            launcher=SubprocessLauncher(),
+            env=tpu_info.chip_visibility_env((), platform="cpu",
+                                             simulate_chips=2))
+        m.set("export_dir", str(tmp_path / "b"))
+        m.setNumExecutors(2).setBatchSize(4).setScoring("sharded")
+        m.setJaxDistributed(True)
+        m.set("reservation_timeout", 180.0)
+        out = list(m.transform(PartitionedDataset.from_iterable(rows, 4)))
+        assert len(out) == 16
+        got = np.stack([r["prediction"] for r in out])
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_transform_sharded_requires_enough_partitions(self):
+        m = pipeline.TPUModel()
+        m.set("export_dir", "/nonexistent")
+        m.setNumExecutors(4).setScoring("sharded")
+        with pytest.raises(ValueError, match="at least one partition"):
+            m.transform(PartitionedDataset.from_iterable(list(range(8)), 2))
+
     def test_estimator_requires_export_dir(self):
         est = pipeline.TPUEstimator(mapfuns.noop, {})
         with pytest.raises(ValueError, match="export_dir"):
@@ -230,3 +272,10 @@ def test_local_rows_dedupes_replicated_mesh_axes():
     arr = jax.device_put(x, meshlib.batch_sharding(mesh, extra_dims=1))
     got = tinfer._local_rows(arr)
     np.testing.assert_array_equal(got, np.asarray(x))
+
+
+def test_transform_rejects_unknown_scoring_mode():
+    m = pipeline.TPUModel()
+    m.set("export_dir", "/nonexistent").set("scoring", "SHARDED")
+    with pytest.raises(ValueError, match="unknown scoring mode"):
+        m.transform(PartitionedDataset.from_iterable(list(range(4)), 2))
