@@ -183,6 +183,72 @@ def read_shard(path: str, schema: Schema | None = None,
         yield from_example(rec, schema, binary_features)
 
 
+def read_shard_columns(path: str, schema: Schema,
+                       binary_features: set | None = None
+                       ) -> tuple[dict, dict]:
+    """Columnar shard decode — the data-loader fast path.
+
+    Returns ``(columns, counts)``: ``columns[name]`` is every value of that
+    feature across the shard, concatenated (``np.float32``/``np.int64``
+    ndarray, or a list of ``bytes``/``str``); ``counts[name]`` is the
+    per-record value count (``uint64``; 0 where a record lacks the feature),
+    so fixed-width columns reshape to ``[n_records, k]`` and ragged ones
+    split by ``np.cumsum``.
+
+    With the native parser (``native/example_parser.cc``) the whole shard is
+    decoded in C++ — two ctypes calls per column instead of a Python proto
+    walk per record (~27x on tabular/float-heavy shards; image-bytes shards
+    are IO-bound either way — see PERF_NOTES).  The pure-Python fallback
+    produces identical output, including dtype-mismatch errors.
+    """
+    import numpy as np
+
+    try:
+        from tensorflowonspark_tpu import example_native
+    except Exception:  # noqa: BLE001 - no compiler: pure-Python fallback
+        example_native = None
+
+    def _decode_bytes(name, values):
+        if binary_features is None or name not in binary_features:
+            return [v.decode("utf-8", errors="replace") for v in values]
+        return values
+
+    if example_native is not None:
+        buf, spans = tfrecord.read_record_spans(path)
+        columns, counts = {}, {}
+        for c in schema.columns:
+            values, cnt = example_native.extract_column(buf, spans, c.name, c.dtype)
+            if c.dtype == "bytes":
+                values = _decode_bytes(c.name, values)
+            columns[c.name] = values
+            counts[c.name] = cnt
+        return columns, counts
+
+    expect = {"bytes": bytes, "float": float, "int64": int}
+    acc: dict[str, list] = {c.name: [] for c in schema.columns}
+    cnt: dict[str, list] = {c.name: [] for c in schema.columns}
+    for rec in tfrecord.read_records(path):
+        raw = ex.decode_example(rec)
+        for c in schema.columns:
+            values = raw.get(c.name, [])
+            # mirror the native path's kind check: a float column read under
+            # an int64 schema must raise, not silently truncate
+            if values and not isinstance(values[0], expect[c.dtype]):
+                raise TypeError(f"feature {c.name!r} is not of dtype {c.dtype!r}")
+            acc[c.name].extend(values)
+            cnt[c.name].append(len(values))
+    columns, counts = {}, {}
+    for c in schema.columns:
+        if c.dtype == "float":
+            columns[c.name] = np.asarray(acc[c.name], np.float32)
+        elif c.dtype == "int64":
+            columns[c.name] = np.asarray(acc[c.name], np.int64)
+        else:
+            columns[c.name] = _decode_bytes(c.name, acc[c.name])
+        counts[c.name] = np.asarray(cnt[c.name], np.uint64)
+    return columns, counts
+
+
 def load_tfrecords(input_dir: str, binary_features: set | None = None) -> tuple[PartitionedDataset, Schema | None]:
     """Load a TFRecord directory as a PartitionedDataset of rows (reference
     ``loadTFRecords``, ``dfutil.py:~60-100``); one partition per shard file."""

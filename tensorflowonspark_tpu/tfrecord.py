@@ -85,6 +85,61 @@ class RecordError(ValueError):
     pass
 
 
+def read_record_spans(path: str, verify: bool = True) -> tuple[bytes, list[tuple[int, int]]]:
+    """Whole-shard buffer + (offset, length) payload spans.
+
+    The zero-copy companion of ``read_records`` for columnar consumers
+    (``dfutil.read_shard_columns`` / the native Example parser): one buffer,
+    one scan, no per-record slicing.  Handles gzip like ``read_records``.
+    """
+    import gzip
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    if _is_gzip_shard(buf[:12]):
+        buf = gzip.decompress(buf)
+    if _native is not None:
+        try:
+            spans, consumed = _native.scan_records(buf, verify)
+        except ValueError as e:
+            raise RecordError(f"{path}: {e}") from None
+        if consumed != len(buf):
+            raise RecordError(f"{path}: truncated record at offset {consumed}")
+        return buf, [(int(o), int(n)) for o, n in spans]
+    spans = []
+    pos = 0
+    while pos < len(buf):
+        if pos + 12 > len(buf):
+            raise RecordError(f"{path}: truncated header at offset {pos}")
+        (length,) = _U64.unpack_from(buf, pos)
+        if verify and masked_crc32c(buf[pos:pos + 8]) != _U32.unpack_from(buf, pos + 8)[0]:
+            raise RecordError(f"{path}: corrupt length crc at offset {pos}")
+        start = pos + 12
+        if start + length + 4 > len(buf):
+            raise RecordError(f"{path}: truncated record at offset {pos}")
+        if verify and masked_crc32c(buf[start:start + length]) != \
+                _U32.unpack_from(buf, start + length)[0]:
+            raise RecordError(f"{path}: corrupt data crc at offset {pos}")
+        spans.append((start, length))
+        pos = start + length + 4
+    return buf, spans
+
+
+def _is_gzip_shard(head: bytes) -> bool:
+    """GZIP-vs-plain detection on a 12-byte header prefix.
+
+    Must not misread a PLAIN shard whose first record length happens to
+    collide with the gzip magic (the header starts with a little-endian
+    uint64 length, so 0x1f 0x8b is reachable): beyond the 3-byte gzip
+    signature, prefer the plain interpretation whenever the header's own
+    masked length-CRC validates — a ~2^-32 discriminator.
+    """
+    if len(head) < 3 or head[:3] != b"\x1f\x8b\x08":
+        return False
+    return not (len(head) >= 12
+                and masked_crc32c(head[:8]) == _U32.unpack_from(head, 8)[0])
+
+
 def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
     """Yield raw record payloads from a TFRecord file.
 
@@ -93,32 +148,15 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
 
     GZIP-compressed shards (TF's ``TFRecordOptions('GZIP')`` format — the
     whole stream gzipped; the reference's Hadoop TFRecord input supported
-    the same) are detected by magic bytes and decompressed transparently.
+    the same) are detected by magic bytes and decompressed transparently
+    (see ``_is_gzip_shard``).
     """
     import gzip
 
-    # Detection must not misread a PLAIN shard whose first record length
-    # happens to collide with the gzip magic (the header starts with a
-    # little-endian uint64 length, so 0x1f 0x8b is reachable): beyond the
-    # 3-byte gzip signature, prefer the plain interpretation whenever the
-    # header's own masked length-CRC validates — a ~2^-32 discriminator.
     with open(path, "rb") as probe:
-        head = probe.read(12)
-    is_gzip = len(head) >= 3 and head[:3] == b"\x1f\x8b\x08"
-    if is_gzip and len(head) == 12 and \
-            masked_crc32c(head[:8]) == _U32.unpack_from(head, 8)[0]:
-        is_gzip = False
+        is_gzip = _is_gzip_shard(probe.read(12))
     if _native is not None:
-        with open(path, "rb") as f:
-            buf = f.read()
-        if is_gzip:
-            buf = gzip.decompress(buf)
-        try:
-            spans, consumed = _native.scan_records(buf, verify)
-        except ValueError as e:
-            raise RecordError(f"{path}: {e}") from None
-        if consumed != len(buf):
-            raise RecordError(f"{path}: truncated record at offset {consumed}")
+        buf, spans = read_record_spans(path, verify)
         for off, length in spans:
             yield buf[off : off + length]
         return

@@ -97,3 +97,133 @@ def test_resave_with_different_compression_clobbers(tmp_path):
     schema = dfutil.read_schema(str(tmp_path / "d"))
     back = [r for s in shards for r in dfutil.read_shard(s, schema)]
     assert len(back) == 4  # no duplicated generations
+
+
+class TestShardColumns:
+    def _write(self, tmp_path, rows, partitions=1):
+        data = PartitionedDataset.from_iterable(rows, partitions)
+        schema = dfutil.save_as_tfrecords(data, str(tmp_path / "cols"))
+        return dfutil.shard_files(str(tmp_path / "cols")), schema
+
+    def test_columns_match_row_decode(self, tmp_path):
+        import numpy as np
+
+        rows = [{"x": [float(i), i + 0.25], "label": i % 5,
+                 "name": f"row-{i}", "blob": bytes([i, i + 1])}
+                for i in range(17)]
+        shards, schema = self._write(tmp_path, rows)
+        cols, counts = dfutil.read_shard_columns(shards[0], schema,
+                                                 binary_features={"blob"})
+        assert cols["x"].dtype == np.float32 and cols["x"].shape == (34,)
+        np.testing.assert_allclose(cols["x"].reshape(17, 2),
+                                   [r["x"] for r in rows])
+        assert cols["label"].dtype == np.int64
+        np.testing.assert_array_equal(cols["label"], [r["label"] for r in rows])
+        assert cols["name"] == [r["name"] for r in rows]          # str decode
+        assert cols["blob"] == [r["blob"] for r in rows]          # raw bytes
+        for name in ("x", "label", "name", "blob"):
+            want = 2 if name == "x" else 1
+            np.testing.assert_array_equal(counts[name],
+                                          [want] * len(rows))
+
+    def test_ragged_and_missing_features(self, tmp_path):
+        import numpy as np
+
+        from tensorflowonspark_tpu import example as ex
+        from tensorflowonspark_tpu import tfrecord
+
+        # hand-build records: ragged int lists, one record missing the column
+        recs = [ex.encode_example({"v": [1, 2, 3], "tag": "a"}),
+                ex.encode_example({"tag": "b"}),
+                ex.encode_example({"v": [-7], "tag": "c"})]
+        p = str(tmp_path / "ragged.tfrecord")
+        tfrecord.write_records(p, recs)
+        schema = dfutil.Schema([dfutil.ColumnSpec("v", "int64", False),
+                                dfutil.ColumnSpec("tag", "bytes", True)])
+        cols, counts = dfutil.read_shard_columns(p, schema)
+        np.testing.assert_array_equal(cols["v"], [1, 2, 3, -7])
+        np.testing.assert_array_equal(counts["v"], [3, 0, 1])
+        assert cols["tag"] == ["a", "b", "c"]
+
+    def test_unpacked_primitive_encodings(self, tmp_path):
+        """TF writes packed primitives; other writers may emit repeated
+        (unpacked) floats/ints — both must decode identically."""
+        import struct
+
+        import numpy as np
+
+        from tensorflowonspark_tpu import tfrecord
+
+        # hand-roll a Feature with UNPACKED floats: float_list(field 2) whose
+        # body repeats field 1 wire-type 5 entries
+        def unpacked_float_feature(vals):
+            body = b"".join(bytes([0x0D]) + struct.pack("<f", v) for v in vals)
+            feat = bytes([0x12, len(body)]) + body          # float_list
+            return feat
+
+        def unpacked_int_feature(vals):
+            body = b""
+            for v in vals:
+                body += bytes([0x08, v])                    # small positives
+            return bytes([0x1A, len(body)]) + body          # int64_list
+
+        def entry(name, feat):
+            e = bytes([0x0A, len(name)]) + name + bytes([0x12, len(feat)]) + feat
+            return bytes([0x0A, len(e)]) + e
+
+        fmap = entry(b"f", unpacked_float_feature([1.5, -2.0])) \
+            + entry(b"i", unpacked_int_feature([3, 9]))
+        rec = bytes([0x0A, len(fmap)]) + fmap
+        p = str(tmp_path / "unpacked.tfrecord")
+        tfrecord.write_records(p, [rec])
+        schema = dfutil.Schema([dfutil.ColumnSpec("f", "float", False),
+                                dfutil.ColumnSpec("i", "int64", False)])
+        cols, counts = dfutil.read_shard_columns(p, schema)
+        np.testing.assert_allclose(cols["f"], [1.5, -2.0])
+        np.testing.assert_array_equal(cols["i"], [3, 9])
+
+    def test_kind_mismatch_raises(self, tmp_path):
+        rows = [{"x": 1.5}]
+        shards, _ = self._write(tmp_path, rows)
+        bad = dfutil.Schema([dfutil.ColumnSpec("x", "int64", True)])
+        with pytest.raises((TypeError, ValueError)):
+            dfutil.read_shard_columns(shards[0], bad)
+
+    def _force_fallback(self, monkeypatch):
+        """Make `from tensorflowonspark_tpu import example_native` raise: a
+        None sys.modules entry raises ImportError at import time (patching
+        builtins.__import__ would NOT work — the already-imported submodule
+        resolves via the package attribute, bypassing the hook)."""
+        import sys
+
+        import tensorflowonspark_tpu as pkg
+
+        monkeypatch.setitem(sys.modules,
+                            "tensorflowonspark_tpu.example_native", None)
+        monkeypatch.delattr(pkg, "example_native", raising=False)
+
+    def test_python_fallback_matches_native(self, tmp_path, monkeypatch):
+        import numpy as np
+
+        rows = [{"x": [float(i)], "label": i, "s": f"v{i}"} for i in range(9)]
+        shards, schema = self._write(tmp_path, rows)
+        native_cols, native_counts = dfutil.read_shard_columns(shards[0], schema)
+
+        self._force_fallback(monkeypatch)
+        with pytest.raises(ImportError):
+            from tensorflowonspark_tpu import example_native  # noqa: F401
+        py_cols, py_counts = dfutil.read_shard_columns(shards[0], schema)
+        for k in native_cols:
+            if isinstance(native_cols[k], list):
+                assert native_cols[k] == py_cols[k]
+            else:
+                np.testing.assert_array_equal(native_cols[k], py_cols[k])
+            np.testing.assert_array_equal(native_counts[k], py_counts[k])
+
+    def test_python_fallback_kind_mismatch_raises(self, tmp_path, monkeypatch):
+        rows = [{"x": 1.5}]
+        shards, _ = self._write(tmp_path, rows)
+        bad = dfutil.Schema([dfutil.ColumnSpec("x", "int64", True)])
+        self._force_fallback(monkeypatch)
+        with pytest.raises(TypeError, match="not of dtype"):
+            dfutil.read_shard_columns(shards[0], bad)

@@ -173,3 +173,18 @@ def test_gzip_pure_python_path(tmp_path, monkeypatch):
     tfrecord.write_records(p, recs)
     monkeypatch.setattr(tfrecord, "_native", None)
     assert list(tfrecord.read_records(p)) == recs
+
+
+def test_read_record_spans_both_paths(tmp_path, monkeypatch):
+    recs = [b"a" * 5, b"bb" * 40, b"c"]
+    plain = str(tmp_path / "s.tfrecord")
+    gz = str(tmp_path / "s.tfrecord.gz")
+    tfrecord.write_records(plain, recs)
+    tfrecord.write_records(gz, recs)
+    for path in (plain, gz):
+        buf, spans = tfrecord.read_record_spans(path)
+        assert [buf[o:o + n] for o, n in spans] == recs
+    monkeypatch.setattr(tfrecord, "_native", None)
+    for path in (plain, gz):
+        buf, spans = tfrecord.read_record_spans(path)
+        assert [buf[o:o + n] for o, n in spans] == recs
