@@ -375,6 +375,20 @@ class TPUCluster:
         return None
 
 
+def _env_float(name: str, default: float) -> float:
+    """Env-tunable default (reference: ``TFOS_SERVER_TIMEOUT``-style knobs,
+    ``reservation.py:~120-160``): ops can raise cluster-formation / feed
+    budgets fleet-wide without touching job code."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default
+
+
 def run(
     map_fun: Callable,
     tf_args: Any = None,
@@ -387,8 +401,8 @@ def run(
     default_fs: str = "",
     queues: Sequence[str] = ("input", "output", "error"),
     queue_capacity: int = 1024,
-    feed_timeout: float = 600.0,
-    reservation_timeout: float = 120.0,
+    feed_timeout: float | None = None,
+    reservation_timeout: float | None = None,
     heartbeat_interval: float = 2.0,
     launcher: Any | None = None,
     env: dict[str, str] | None = None,
@@ -405,7 +419,16 @@ def run(
     layers per-process overrides on top — the carrier for disjoint
     accelerator slices (``tpu_info.chip_visibility_env``) when several node
     processes share a host.
+
+    ``reservation_timeout``/``feed_timeout`` default from the
+    ``TOS_RESERVATION_TIMEOUT``/``TOS_FEED_TIMEOUT`` env vars when not given
+    (the reference's ``TFOS_SERVER_TIMEOUT``-style ops knobs), else
+    120s/600s.
     """
+    if reservation_timeout is None:
+        reservation_timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
+    if feed_timeout is None:
+        feed_timeout = _env_float("TOS_FEED_TIMEOUT", 600.0)
     if per_node_env is not None and len(per_node_env) != num_executors:
         raise ValueError(f"per_node_env needs {num_executors} entries, got {len(per_node_env)}")
     roles = _build_roles(num_executors, master_node, eval_node)

@@ -197,7 +197,7 @@ def read_shard_columns(path: str, schema: Schema,
 
     With the native parser (``native/example_parser.cc``) the whole shard is
     decoded in C++ — two ctypes calls per column instead of a Python proto
-    walk per record (~27x on tabular/float-heavy shards; image-bytes shards
+    walk per record (~25x on tabular/float-heavy shards; image-bytes shards
     are IO-bound either way — see PERF_NOTES).  The pure-Python fallback
     produces identical output, including dtype-mismatch errors.
     """
@@ -215,6 +215,7 @@ def read_shard_columns(path: str, schema: Schema,
 
     if example_native is not None:
         buf, spans = tfrecord.read_record_spans(path)
+        spans = example_native.span_arrays(spans)  # one O(n) walk, not per column
         columns, counts = {}, {}
         for c in schema.columns:
             values, cnt = example_native.extract_column(buf, spans, c.name, c.dtype)

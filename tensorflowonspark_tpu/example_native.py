@@ -45,21 +45,33 @@ def _u64(a: np.ndarray):
     return a.ctypes.data_as(_U64P)
 
 
-def extract_column(buf: bytes, spans: list[tuple[int, int]], name: str,
-                   dtype: str):
-    """Extract one feature column across all records of a shard buffer.
-
-    ``spans`` are the (offset, length) record payloads from
-    ``tfrecord`` scanning.  Returns ``(values, counts)``: ``counts`` is the
-    per-record value count (uint64, 0 where the feature is absent) and
-    ``values`` is a ``float32``/``int64`` ndarray of all values
-    concatenated, or for ``dtype='bytes'`` a list of ``bytes`` (zero-copy
-    decided here: sliced from ``buf``).
-    """
-    kind = KINDS[dtype]
+def span_arrays(spans: list[tuple[int, int]]) -> tuple[np.ndarray, np.ndarray]:
+    """(offsets, lengths) uint64 arrays for a span list — build ONCE per
+    shard and reuse across every extract_column call (the conversion is an
+    O(n_records) Python walk that must not repeat per column)."""
     n = len(spans)
     offs = np.fromiter((o for o, _ in spans), np.uint64, count=n)
     lens = np.fromiter((l for _, l in spans), np.uint64, count=n)
+    return offs, lens
+
+
+def extract_column(buf: bytes, spans, name: str, dtype: str):
+    """Extract one feature column across all records of a shard buffer.
+
+    ``spans`` is either the (offset, length) list from ``tfrecord`` scanning
+    or a prebuilt ``span_arrays`` result.  Returns ``(values, counts)``:
+    ``counts`` is the per-record value count (uint64, 0 where the feature is
+    absent) and ``values`` is a ``float32``/``int64`` ndarray of all values
+    concatenated, or for ``dtype='bytes'`` a list of ``bytes`` (sliced from
+    ``buf``).
+    """
+    kind = KINDS[dtype]
+    if isinstance(spans, tuple) and len(spans) == 2 \
+            and isinstance(spans[0], np.ndarray):
+        offs, lens = spans
+    else:
+        offs, lens = span_arrays(spans)
+    n = len(offs)
     counts = np.zeros(n, np.uint64)
     found = ctypes.c_int(0)
     bname = name.encode("utf-8")

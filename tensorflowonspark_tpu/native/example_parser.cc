@@ -40,7 +40,9 @@ struct Reader {
       result |= static_cast<uint64_t>(b & 0x7F) << shift;
       if (!(b & 0x80)) return result;
       shift += 7;
-      if (shift > 70) break;
+      // reject overlong (>10 byte) varints BEFORE a >=64-bit shift (UB);
+      // the canonical 10th byte shifts by 63, which is defined
+      if (shift >= 64) break;
     }
     ok = false;
     return 0;
@@ -72,9 +74,13 @@ struct Reader {
 
 // Find the named feature's kind payload inside one Example record.
 // Returns: 1/2/3 = kind found, 0 = feature absent, -1 = parse error.
+// Proto map semantics: when a key appears multiple times on the wire the
+// LAST entry wins (matching the pure-Python decode_example fallback), so
+// the walk continues to the end of the record instead of early-returning.
 int find_feature(const uint8_t* rec, size_t rec_len,
                  const uint8_t* name, size_t name_len,
                  const uint8_t** kind_payload, size_t* kind_len) {
+  int result_kind = 0;
   Reader ex(rec, rec_len);
   while (!ex.done()) {
     uint64_t key = ex.varint();
@@ -108,19 +114,22 @@ int find_feature(const uint8_t* rec, size_t rec_len,
           }
           if (ename && ename_len == name_len &&
               memcmp(ename, name, name_len) == 0 && feat) {
-            // Feature { oneof kind } — first kind field wins.
+            // record this entry's kind; keep walking (last map entry wins)
             Reader f(feat, feat_len);
+            bool matched = false;
             while (!f.done()) {
               uint64_t kkey = f.varint();
               if (!f.ok) return -1;
               int kf = static_cast<int>(kkey >> 3), kw = static_cast<int>(kkey & 7);
-              if ((kf == 1 || kf == 2 || kf == 3) && kw == 2) {
+              if ((kf == 1 || kf == 2 || kf == 3) && kw == 2 && !matched) {
                 if (!f.subspan(kind_payload, kind_len)) return -1;
-                return kf;
+                result_kind = kf;
+                matched = true;
+              } else if (!f.skip(kw)) {
+                return -1;
               }
-              if (!f.skip(kw)) return -1;
             }
-            return 0;  // feature present but empty
+            if (!matched) result_kind = 0;  // present but empty: resets too
           }
         } else if (!feats.skip(fw)) {
           return -1;
@@ -130,7 +139,7 @@ int find_feature(const uint8_t* rec, size_t rec_len,
       if (!ex.skip(wire)) return -1;
     }
   }
-  return 0;
+  return result_kind;
 }
 
 // Walk a kind payload (BytesList/FloatList/Int64List body), invoking the

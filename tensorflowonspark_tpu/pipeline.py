@@ -169,7 +169,9 @@ class HasReaders(Params):
 
 
 class HasFeedTimeout(Params):
-    feed_timeout = Param("feed_timeout", 600.0, "seconds before a stalled feed errors")
+    feed_timeout = Param("feed_timeout", None,
+                         "seconds before a stalled feed errors "
+                         "(default: TOS_FEED_TIMEOUT env or 600)")
 
 
 class HasShuffleSeed(Params):
@@ -178,8 +180,9 @@ class HasShuffleSeed(Params):
 
 
 class HasReservationTimeout(Params):
-    reservation_timeout = Param("reservation_timeout", 120.0,
-                                "seconds to wait for all nodes to register")
+    reservation_timeout = Param("reservation_timeout", None,
+                                "seconds to wait for all nodes to register "
+                                "(default: TOS_RESERVATION_TIMEOUT env or 120)")
 
 
 class HasJaxDistributed(Params):

@@ -153,13 +153,13 @@ def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
     """
     import gzip
 
-    with open(path, "rb") as probe:
-        is_gzip = _is_gzip_shard(probe.read(12))
     if _native is not None:
         buf, spans = read_record_spans(path, verify)
         for off, length in spans:
             yield buf[off : off + length]
         return
+    with open(path, "rb") as probe:
+        is_gzip = _is_gzip_shard(probe.read(12))
     with (gzip.open(path, "rb") if is_gzip else open(path, "rb")) as f:
         offset = 0
         while True:

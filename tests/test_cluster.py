@@ -152,3 +152,22 @@ def test_global_done_consensus(tmp_path):
     rounds = [int((tmp_path / f"rounds_{i}.txt").read_text()) for i in range(3)]
     # all nodes leave the loop on the same (last) round: consensus, not local state
     assert rounds == [3, 3, 3]
+
+
+def test_env_tunable_timeouts(monkeypatch):
+    """TOS_RESERVATION_TIMEOUT / TOS_FEED_TIMEOUT env defaults (reference:
+    TFOS_SERVER_TIMEOUT-style ops knobs) apply when the kwargs are omitted;
+    explicit kwargs always win; junk values fall back with a warning."""
+    monkeypatch.setenv("TOS_RESERVATION_TIMEOUT", "7.5")
+    monkeypatch.setenv("TOS_FEED_TIMEOUT", "33")
+    cluster = tos.run(mapfuns.noop, num_executors=1)
+    try:
+        assert cluster.feed_timeout == 33.0
+    finally:
+        cluster.shutdown()
+    monkeypatch.setenv("TOS_FEED_TIMEOUT", "not-a-number")
+    cluster = tos.run(mapfuns.noop, num_executors=1, reservation_timeout=60)
+    try:
+        assert cluster.feed_timeout == 600.0  # junk ignored
+    finally:
+        cluster.shutdown()

@@ -227,3 +227,31 @@ class TestShardColumns:
         self._force_fallback(monkeypatch)
         with pytest.raises(TypeError, match="not of dtype"):
             dfutil.read_shard_columns(shards[0], bad)
+
+    def test_duplicate_map_keys_last_wins_both_paths(self, tmp_path, monkeypatch):
+        """Proto map semantics: the LAST entry for a repeated key wins — in
+        the native parser AND the Python fallback."""
+        import numpy as np
+
+        from tensorflowonspark_tpu import example as ex
+        from tensorflowonspark_tpu import tfrecord
+
+        def entry(name, feat):
+            e = bytes([0x0A, len(name)]) + name + bytes([0x12, len(feat)]) + feat
+            return bytes([0x0A, len(e)]) + e
+
+        def int_feature(v):
+            body = bytes([0x0A, 0x01, v])          # packed int64_list [v]
+            return bytes([0x1A, len(body)]) + body
+
+        fmap = entry(b"k", int_feature(7)) + entry(b"k", int_feature(9))
+        rec = bytes([0x0A, len(fmap)]) + fmap
+        assert ex.decode_example(rec) == {"k": [9]}  # python reference
+        p = str(tmp_path / "dup.tfrecord")
+        tfrecord.write_records(p, [rec])
+        schema = dfutil.Schema([dfutil.ColumnSpec("k", "int64", True)])
+        cols, counts = dfutil.read_shard_columns(p, schema)
+        np.testing.assert_array_equal(cols["k"], [9])
+        self._force_fallback(monkeypatch)
+        cols2, _ = dfutil.read_shard_columns(p, schema)
+        np.testing.assert_array_equal(cols2["k"], [9])

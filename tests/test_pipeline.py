@@ -279,3 +279,15 @@ def test_transform_rejects_unknown_scoring_mode():
     m.set("export_dir", "/nonexistent").set("scoring", "SHARDED")
     with pytest.raises(ValueError, match="unknown scoring mode"):
         m.transform(PartitionedDataset.from_iterable(list(range(4)), 2))
+
+
+def test_env_timeout_knobs_reach_pipeline(monkeypatch):
+    """TOS_* env defaults must apply through TFEstimator/TFModel too, not
+    only direct cluster.run callers (the Params now default to None and
+    defer)."""
+    monkeypatch.setenv("TOS_FEED_TIMEOUT", "77")
+    ns = pipeline.TPUParams().merge_args_params({})
+    assert ns.feed_timeout is None  # deferred to cluster.run's env lookup
+    from tensorflowonspark_tpu.cluster import _env_float
+
+    assert _env_float("TOS_FEED_TIMEOUT", 600.0) == 77.0
