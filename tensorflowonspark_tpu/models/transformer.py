@@ -286,6 +286,63 @@ def pad_batch(token_lists, seq_len: int, pad_id: int = 0):
     return {"input_ids": ids, "loss_mask": mask}
 
 
+def pack_batch(token_lists, seq_len: int, eos_id: int, pad_id: int = 0,
+               n_rows: int | None = None):
+    """Greedy sequence packing — the padding-free alternative to ``pad_batch``.
+
+    Documents are laid back-to-back (each terminated by ``eos_id``) into
+    fixed ``seq_len`` rows, first-fit: a document goes into the first row
+    with room, else opens a new row; documents longer than ``seq_len``-1 are
+    split across rows (GPT-style chunking).  Returns ``{"input_ids": [B,S],
+    "loss_mask": [B,S]}`` where the mask marks real tokens (EOS included —
+    predicting document ends is part of the LM task; only tail padding is
+    masked out).
+
+    The natural row count is CONTENT-DEPENDENT — under a jitted train loop
+    a varying ``B`` means a recompile per new shape, and ``B`` must divide
+    the batch mesh axes.  Pass ``n_rows`` to fix the batch dimension: short
+    packs are padded with all-masked rows, and a pack that needs more than
+    ``n_rows`` rows raises (size your budget from the token count:
+    ``n_rows >= ceil(sum(len(d)+1) / seq_len)`` plus fragmentation slack).
+
+    Semantics note: this is standard dense packing WITHOUT attention
+    resetting — tokens may attend across document boundaries within a row
+    (the usual GPT pretraining trade; the EOS token is the separator signal).
+    For MoE models this is the recommended input shape: pad tokens occupy
+    expert capacity, packed tokens don't (see ``pad_batch``'s caveat).
+    """
+    import numpy as np
+
+    rows: list[list[int]] = []
+    for toks in token_lists:
+        doc = list(toks) + [eos_id]
+        placed = False
+        for row in rows:
+            if len(row) + len(doc) <= seq_len:
+                row.extend(doc)
+                placed = True
+                break
+        if not placed:
+            while len(doc) > seq_len:
+                rows.append(doc[:seq_len])
+                doc = doc[seq_len:]
+            if doc:
+                rows.append(doc)
+    if n_rows is not None:
+        if len(rows) > n_rows:
+            raise ValueError(
+                f"pack needs {len(rows)} rows of {seq_len} but n_rows={n_rows}; "
+                "raise n_rows or feed fewer tokens per pack")
+        rows.extend([] for _ in range(n_rows - len(rows)))
+    b = len(rows)
+    ids = np.full((b, seq_len), pad_id, np.int32)
+    mask = np.zeros((b, seq_len), np.float32)
+    for i, row in enumerate(rows):
+        ids[i, : len(row)] = np.asarray(row, np.int32)
+        mask[i, : len(row)] = 1.0
+    return {"input_ids": ids, "loss_mask": mask}
+
+
 def greedy_generate(model: Transformer, params, prompt_ids, max_new_tokens: int,
                     max_decode_len: int = 0, temperature: float = 0.0,
                     top_k: int = 0, seed: int = 0,

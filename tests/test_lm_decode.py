@@ -179,3 +179,41 @@ def test_sampled_generation_valid_and_deterministic(lm):
     np.testing.assert_array_equal(a, b)              # deterministic per seed
     assert a.shape == (2, 9)
     assert ((a >= 0) & (a < 29)).all()               # valid token ids
+
+
+def test_pack_batch_dense_and_trainable(lm):
+    """pack_batch lays documents back-to-back with EOS separators: fewer
+    rows than pad_batch, every real token (incl. EOS) in the loss, only tail
+    padding masked; long documents split across rows; the packed batch runs
+    straight through make_loss_fn."""
+    model, _, params = lm
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12, 13, 14], [15]]
+    batch = tfm.pack_batch(docs, seq_len=8, eos_id=28, pad_id=0)
+    ids, mask = batch["input_ids"], batch["loss_mask"]
+    assert ids.shape[1] == 8
+    # every document's tokens + its EOS appear exactly once, in order
+    flat = [t for row, m in zip(ids, mask) for t, keep in zip(row, m) if keep]
+    want = [t for d in docs for t in d + [28]]
+    assert sorted(flat) == sorted(want)
+    # doc 0 and doc 1 share a row (3+1+2+1 = 7 <= 8): packing, not padding
+    assert list(ids[0][:7]) == [1, 2, 3, 28, 4, 5, 28]
+    # the 9-token doc really SPLIT across two distinct rows (9+1 > 8):
+    # its head token and tail token land in different rows
+    (row_of_6,) = [i for i, row in enumerate(ids) if 6 in row]
+    (row_of_14,) = [i for i, row in enumerate(ids) if 14 in row]
+    assert row_of_6 != row_of_14
+    # fixed-B mode: short packs pad with all-masked rows; overflow raises
+    fixed = tfm.pack_batch(docs, seq_len=8, eos_id=28, n_rows=6)
+    assert fixed["input_ids"].shape == (6, 8)
+    assert fixed["loss_mask"][-1].sum() == 0
+    with pytest.raises(ValueError, match="raise n_rows"):
+        tfm.pack_batch(docs, seq_len=8, eos_id=28, n_rows=2)
+    # masked loss runs through the standard loss path
+    import jax
+
+    loss_fn = tfm.make_loss_fn(model)
+    loss, _ = jax.jit(loss_fn)(params, batch)
+    assert np.isfinite(float(loss))
+    # packing beats padding on row count for this ragged set
+    padded = tfm.pad_batch(docs, seq_len=8)
+    assert ids.shape[0] < padded["input_ids"].shape[0]
