@@ -65,7 +65,12 @@ def _evaluator_loop(args, ctx):
             if path is not None:
                 step_no = int(path.rsplit("_", 1)[1])
                 if step_no > last_step:
-                    params = restore_checkpoint(path)["params"]
+                    try:
+                        params = restore_checkpoint(path)["params"]
+                    except Exception:  # noqa: BLE001 - keep-K GC race: the
+                        # chief may delete step_N while we read it; a newer
+                        # step exists in that case — retry next poll
+                        continue
                     logits = jax.device_get(apply_fn(params, batch["image"]))
                     labels = np.asarray(batch["label"])
                     acc = float((np.asarray(logits).argmax(-1) == labels).mean())

@@ -198,6 +198,14 @@ class TPUCluster:
             )
         dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
         num_workers = len(self._feed_ids)
+        if eof_when_done:
+            # Global-mesh scoring cannot be window-gated: a node whose next
+            # partition is gated on earlier global output would stop feeding
+            # its SPMD rounds while its peers wait for it in a collective —
+            # a circular wait.  Sharded scoring therefore always dispatches
+            # freely (driver may hold up to all partitions, as inference()
+            # already does).
+            window = dataset.num_partitions + 1
         window = window if window is not None else max(2 * num_workers, 4)
         buf: dict[int, list] = {}
         cond = threading.Condition()
@@ -383,10 +391,16 @@ def _env_float(name: str, default: float) -> float:
     if not raw:
         return default
     try:
-        return float(raw)
+        value = float(raw)
     except ValueError:
         logger.warning("ignoring non-numeric %s=%r", name, raw)
         return default
+    if value <= 0:
+        # 0 is NOT "no timeout" here: it would make every data-plane put
+        # fail instantly; fail safe to the default instead
+        logger.warning("ignoring non-positive %s=%r", name, raw)
+        return default
+    return value
 
 
 def run(

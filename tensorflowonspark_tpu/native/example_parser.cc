@@ -129,7 +129,11 @@ int find_feature(const uint8_t* rec, size_t rec_len,
                 return -1;
               }
             }
-            if (!matched) result_kind = 0;  // present but empty: resets too
+            // Empty kind payloads (zero values) report as ABSENT: the
+            // pure-Python fallback cannot recover the kind of an empty
+            // feature either, so this keeps both paths identical (incl.
+            // not raising a kind mismatch for a valueless feature).
+            if (!matched || *kind_len == 0) result_kind = 0;
           }
         } else if (!feats.skip(fw)) {
           return -1;

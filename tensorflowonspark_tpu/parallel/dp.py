@@ -207,6 +207,15 @@ def make_batch_iterator(
     disappears behind the device step instead of serializing with it.  Set
     ``prefetch=0`` for strictly synchronous delivery.
 
+    Weighting caveat (applies to the final batches of any uneven run): PAD
+    rows (partial final batch) and FILLER rows (a dry host's lockstep
+    batches, ``n=0``) participate in the global loss mean like real rows —
+    duplicated last-sample data carries gradient mass for those few steps.
+    This mirrors the reference's padded-batch semantics; for strictly
+    unbiased tails either shard data evenly across hosts, or use the
+    returned ``n`` to weight/skip the update (``n`` is per-HOST; a filler
+    round has ``n=0``).
+
     ``max_steps`` >= 0 caps the number of yielded batches (the pipeline
     layer's ``steps`` Param; reference ``args.steps`` semantics —
     ``None`` and ``-1`` both mean uncapped, so ``args.get("steps")`` can be

@@ -255,3 +255,29 @@ class TestShardColumns:
         self._force_fallback(monkeypatch)
         cols2, _ = dfutil.read_shard_columns(p, schema)
         np.testing.assert_array_equal(cols2["k"], [9])
+
+    def test_empty_feature_absent_both_paths(self, tmp_path, monkeypatch):
+        """A present-but-VALUELESS feature counts as absent in both decode
+        paths — even when its (empty) wire kind mismatches the schema: you
+        cannot type an empty list, so no kind error is raised."""
+        import numpy as np
+
+        from tensorflowonspark_tpu import tfrecord
+
+        def entry(name, feat):
+            e = bytes([0x0A, len(name)]) + name + bytes([0x12, len(feat)]) + feat
+            return bytes([0x0A, len(e)]) + e
+
+        empty_float_list = bytes([0x12, 0x00])      # float_list {}
+        fmap = entry(b"x", empty_float_list)
+        rec = bytes([0x0A, len(fmap)]) + fmap
+        p = str(tmp_path / "empty.tfrecord")
+        tfrecord.write_records(p, [rec])
+        schema = dfutil.Schema([dfutil.ColumnSpec("x", "int64", True)])
+        cols, counts = dfutil.read_shard_columns(p, schema)  # no TypeError
+        assert len(cols["x"]) == 0
+        np.testing.assert_array_equal(counts["x"], [0])
+        self._force_fallback(monkeypatch)
+        cols2, counts2 = dfutil.read_shard_columns(p, schema)
+        assert len(cols2["x"]) == 0
+        np.testing.assert_array_equal(counts2["x"], [0])

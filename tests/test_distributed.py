@@ -399,9 +399,14 @@ def test_two_process_sharded_streaming_inference(tmp_path):
         log_dir=str(tmp_path / "logs"),
         reservation_timeout=180.0,
     )
-    results = cluster.inference(PartitionedDataset.from_iterable(rows, 5),
-                                eof_when_done=True)
+    # window=1 would CIRCULAR-WAIT here without the sharded-mode clamp
+    # (a window-gated node stops feeding its SPMD rounds while peers wait
+    # for it in a collective); eof_when_done must force free dispatch
+    parts_out = dict(cluster.inference_stream(
+        PartitionedDataset.from_iterable(rows, 5), window=1,
+        eof_when_done=True))
     cluster.shutdown(timeout=300.0)
+    results = [x for p in sorted(parts_out) for x in parts_out[p]]
     assert len(results) == 24
     np.testing.assert_allclose(np.stack(results), expected,
                                rtol=1e-4, atol=1e-5)
