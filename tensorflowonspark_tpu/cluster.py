@@ -422,6 +422,7 @@ def run(
     env: dict[str, str] | None = None,
     per_node_env: Sequence[dict[str, str]] | None = None,
     jax_distributed: bool = False,
+    coordinator_host: str | None = None,
 ) -> TPUCluster:
     """Start a cluster (reference ``TFCluster.run`` ``:~270-420``).
 
@@ -438,6 +439,13 @@ def run(
     ``TOS_RESERVATION_TIMEOUT``/``TOS_FEED_TIMEOUT`` env vars when not given
     (the reference's ``TFOS_SERVER_TIMEOUT``-style ops knobs), else
     120s/600s.
+
+    ``coordinator_host`` pins the control-plane bind/advertise interface
+    (default: bind all interfaces, advertise the routable ``local_ip()`` so
+    remote executors launched over ssh can actually dial back — reference
+    ``reservation.Server`` behavior).  The control plane authenticates every
+    connection with the per-cluster ``authkey`` (HMAC challenge-response,
+    same handshake as the data plane).
     """
     if reservation_timeout is None:
         reservation_timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
@@ -446,9 +454,9 @@ def run(
     if per_node_env is not None and len(per_node_env) != num_executors:
         raise ValueError(f"per_node_env needs {num_executors} entries, got {len(per_node_env)}")
     roles = _build_roles(num_executors, master_node, eval_node)
-    coordinator = CoordinatorServer(num_executors, roles)
-    addr = coordinator.start()
     authkey = secrets.token_bytes(16)
+    coordinator = CoordinatorServer(num_executors, roles, authkey=authkey)
+    addr = coordinator.start(coordinator_host)
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
 

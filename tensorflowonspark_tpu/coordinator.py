@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import socket
 import socketserver
 import struct
@@ -95,10 +96,17 @@ class CoordinatorServer:
     provides barrier/reduce/heartbeat/error channels.
     """
 
-    def __init__(self, expected: int, roles: list[tuple[str, int]] | None = None):
+    def __init__(self, expected: int, roles: list[tuple[str, int]] | None = None,
+                 authkey: bytes | None = None):
         if roles is not None and len(roles) != expected:
             raise ValueError("roles must have one entry per expected node")
         self.expected = expected
+        # Shared cluster authkey: when set, every connection must pass the
+        # HMAC challenge-response before its first frame is read.  The control
+        # plane accepts register/stop from the network once it binds a
+        # routable interface, so it gets the same gate the pickle-carrying
+        # data plane always had (utils/net.py handshake).
+        self.authkey = authkey
         # role for executor i; default: executor 0 is chief, rest workers.
         self.roles = roles or [("chief", 0)] + [("worker", i) for i in range(1, expected)]
         self._lock = threading.Lock()
@@ -114,11 +122,38 @@ class CoordinatorServer:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self, host: str = "127.0.0.1") -> tuple[str, int]:
+    def start(self, host: str | None = None) -> tuple[str, int]:
+        """Bind and return the address nodes should dial.
+
+        When an ``authkey`` is set, binds all interfaces by default so
+        *remote* executors can register (reference parity:
+        ``reservation.Server`` served the driver's routable address to every
+        executor, ``reservation.py:~120-200``) — but **advertises** the
+        routable ``local_ip()``, never the wildcard or loopback, because the
+        returned address is baked into every ``NodeConfig.coordinator_addr``
+        shipped to (possibly remote) nodes.  Without an authkey the default
+        bind stays loopback: an unauthenticated register/stop channel must
+        not be network-reachable.  Pass ``host`` (or set
+        ``TOS_COORDINATOR_HOST``) to pin a specific interface; that exact
+        address is then advertised.
+        """
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one connection, many requests
+                if outer.authkey is not None:
+                    from tensorflowonspark_tpu.utils.net import hmac_handshake_server
+
+                    # Bounded handshake: an idle peer (port scanner, half-open
+                    # connect) must not pin this handler thread + fd forever.
+                    try:
+                        self.request.settimeout(10.0)
+                        if not hmac_handshake_server(self.request, outer.authkey):
+                            logger.warning("rejected control-plane connection: bad authkey")
+                            return
+                        self.request.settimeout(None)
+                    except (ConnectionError, OSError):
+                        return
                 try:
                     while True:
                         msg = _recv_msg(self.request)
@@ -133,8 +168,22 @@ class CoordinatorServer:
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = Server((host, 0), Handler)
-        self.address = self._server.server_address
+        if host is None:
+            # Only an authenticated server may take a network bind from the
+            # environment — TOS_COORDINATOR_HOST must never silently expose
+            # an unauthenticated register/stop channel.
+            host = (os.environ.get("TOS_COORDINATOR_HOST", "")
+                    if self.authkey is not None else "127.0.0.1")
+        bind_host = "" if host in ("", "0.0.0.0") else host
+        self._server = Server((bind_host, 0), Handler)
+        port = self._server.server_address[1]
+        if bind_host == "":
+            from tensorflowonspark_tpu.utils.net import local_ip
+
+            advertise = local_ip()
+        else:
+            advertise = bind_host
+        self.address = (advertise, port)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="coordinator")
         self._thread.start()
         logger.info("coordinator listening on %s:%d (expecting %d nodes)", *self.address, self.expected)
@@ -282,10 +331,27 @@ class CoordinatorServer:
 class CoordinatorClient:
     """Node-side client (reference ``reservation.Client``), persistent socket."""
 
-    def __init__(self, address: tuple[str, int], connect_timeout: float = 30.0):
+    def __init__(self, address: tuple[str, int], connect_timeout: float = 30.0,
+                 authkey: bytes | None = None):
         self.address = (address[0], int(address[1]))
         self._lock = threading.Lock()
         self._sock = socket.create_connection(self.address, timeout=connect_timeout)
+        if authkey is not None:
+            from tensorflowonspark_tpu.utils.net import hmac_handshake_client
+
+            # connect_timeout still governs the socket here, so a server
+            # that never sends a nonce (authkey=None config mismatch) fails
+            # within it rather than hanging; close the fd on ANY failure.
+            try:
+                accepted = hmac_handshake_client(self._sock, authkey)
+            except (OSError, ConnectionError) as e:
+                self._sock.close()
+                raise ConnectionError(
+                    f"coordinator handshake failed ({e}); authkey mismatch or "
+                    "unauthenticated server?") from e
+            if not accepted:
+                self._sock.close()
+                raise ConnectionError("coordinator rejected authkey")
         self._sock.settimeout(None)
         self._gen = 0
 

@@ -26,8 +26,6 @@ Invariants preserved:
 from __future__ import annotations
 
 import contextlib
-import hmac
-import hashlib
 import logging
 import os
 import pickle
@@ -44,27 +42,12 @@ from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">Q")
-_NONCE_BYTES = 32
 
-
-def _hmac_handshake_server(sock: socket.socket, authkey: bytes) -> bool:
-    """Challenge the client; constant-time digest compare, no pickle involved."""
-    nonce = os.urandom(_NONCE_BYTES)
-    sock.sendall(nonce)
-    expected = hmac.new(authkey, nonce, hashlib.sha256).digest()
-    got = _recv_raw(sock, len(expected))
-    ok = hmac.compare_digest(expected, got)
-    sock.sendall(b"OK" if ok else b"NO")
-    return ok
-
-
-def _hmac_handshake_client(sock: socket.socket, authkey: bytes) -> bool:
-    nonce = _recv_raw(sock, _NONCE_BYTES)
-    sock.sendall(hmac.new(authkey, nonce, hashlib.sha256).digest())
-    return _recv_raw(sock, 2) == b"OK"
-
-
-from tensorflowonspark_tpu.utils.net import recv_exact as _recv_raw  # noqa: E402
+from tensorflowonspark_tpu.utils.net import (  # noqa: E402
+    hmac_handshake_client as _hmac_handshake_client,
+    hmac_handshake_server as _hmac_handshake_server,
+    recv_exact as _recv_raw,
+)
 
 
 def _force_put(q: queue.Queue, item: Any) -> None:

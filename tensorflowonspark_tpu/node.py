@@ -236,7 +236,7 @@ def node_main(config: NodeConfig) -> int:
         force=True,
     )
 
-    client = CoordinatorClient(config.coordinator_addr)
+    client = CoordinatorClient(config.coordinator_addr, authkey=config.authkey)
     queues = FeedQueues(config.queues, config.queue_capacity)
     server = DataServer(queues, config.authkey, config.feed_timeout)
     data_port = server.start()
@@ -315,7 +315,7 @@ def node_main(config: NodeConfig) -> int:
         from tensorflowonspark_tpu.dataserver import _force_put
 
         try:
-            hb_client = CoordinatorClient(config.coordinator_addr)
+            hb_client = CoordinatorClient(config.coordinator_addr, authkey=config.authkey)
         except Exception:
             return
         failures = 0

@@ -48,3 +48,51 @@ def local_ip() -> str:
             return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
+
+
+_NONCE_BYTES = 32
+# Domain separation for the server's proof: without it a rogue server could
+# reflect the client's own digest back as "proof" of knowing the authkey.
+_SRV_PROOF_PREFIX = b"tos-coordinator-srv:"
+
+
+def _digest(authkey: bytes, payload: bytes) -> bytes:
+    import hashlib
+    import hmac
+
+    return hmac.new(authkey, payload, hashlib.sha256).digest()
+
+
+def hmac_handshake_server(sock: socket.socket, authkey: bytes) -> bool:
+    """MUTUAL challenge-response on the shared cluster authkey;
+    constant-time digest compares before any payload deserialization.
+    Shared by the data plane (pickle frames, ``dataserver.py``) and the
+    control plane (JSON frames, ``coordinator.py``) — the two-way form of
+    the ``multiprocessing`` authkey handshake the reference's manager
+    queues relied on (``TFManager.py:~20-40``): the server verifies the
+    client AND proves its own knowledge of the key, so a port-squatting
+    impostor cannot impersonate the coordinator to a dialing node."""
+    import hmac
+    import os
+
+    nonce_s = os.urandom(_NONCE_BYTES)
+    sock.sendall(nonce_s)
+    buf = recv_exact(sock, 2 * _NONCE_BYTES)  # client nonce + client digest
+    nonce_c, got = buf[:_NONCE_BYTES], buf[_NONCE_BYTES:]
+    ok = hmac.compare_digest(_digest(authkey, nonce_s), got)
+    # Always answer with a fixed-size proof frame; a failed verify gets
+    # random bytes (never a digest), so the peer's compare fails too.
+    sock.sendall(_digest(authkey, _SRV_PROOF_PREFIX + nonce_c) if ok
+                 else os.urandom(_NONCE_BYTES))
+    return ok
+
+
+def hmac_handshake_client(sock: socket.socket, authkey: bytes) -> bool:
+    import hmac
+    import os
+
+    nonce_s = recv_exact(sock, _NONCE_BYTES)
+    nonce_c = os.urandom(_NONCE_BYTES)
+    sock.sendall(nonce_c + _digest(authkey, nonce_s))
+    proof = recv_exact(sock, _NONCE_BYTES)
+    return hmac.compare_digest(proof, _digest(authkey, _SRV_PROOF_PREFIX + nonce_c))
