@@ -54,7 +54,7 @@ def _evaluator_loop(args, ctx):
               if args.get("log_dir") else None)
     done_marker = os.path.join(resolve_uri(args["model_dir"]), "TRAINING_DONE")
     interval = float(args.get("eval_interval", 10.0))
-    last_step, evals = -1, []
+    last_step, evals, fails = -1, [], 0
     try:
         while True:
             # read the marker BEFORE the checkpoint listing: a marker that
@@ -62,15 +62,22 @@ def _evaluator_loop(args, ctx):
             # checkpoint can appear after this evaluation
             training_done = os.path.exists(done_marker)
             path = latest_step_dir(args["model_dir"])
-            if path is not None:
-                step_no = int(path.rsplit("_", 1)[1])
-                if step_no > last_step:
-                    try:
-                        params = restore_checkpoint(path)["params"]
-                    except Exception:  # noqa: BLE001 - keep-K GC race: the
-                        # chief may delete step_N while we read it; a newer
-                        # step exists in that case — retry next poll
-                        continue
+            step_no = int(path.rsplit("_", 1)[1]) if path is not None else None
+            if step_no is not None and step_no > last_step:
+                try:
+                    params = restore_checkpoint(path)["params"]
+                except Exception:  # noqa: BLE001 - keep-K GC race: the
+                    # chief may delete step_N while we read it; a newer
+                    # step exists in that case — retry next poll.  NOT
+                    # `continue` (that would skip the exit check and the
+                    # interval wait below, busy-spinning forever on a
+                    # persistently unreadable checkpoint); instead count
+                    # consecutive failures so the exit path can give up on
+                    # an unreadable FINAL checkpoint after a few polls.
+                    params = None
+                    fails += 1
+                if params is not None:
+                    fails = 0
                     logits = jax.device_get(apply_fn(params, batch["image"]))
                     labels = np.asarray(batch["label"])
                     acc = float((np.asarray(logits).argmax(-1) == labels).mean())
@@ -79,9 +86,13 @@ def _evaluator_loop(args, ctx):
                     evals.append({"step": step_no, "accuracy": acc})
                     ctx.update_meta({"evals": evals})
                     last_step = step_no
-            if training_done or ctx.stop_requested.is_set():
+            # honor training_done only once the NEWEST checkpoint was scored
+            # (or retried past its bound): a transient restore failure on the
+            # final step must not skip the final evaluation.
+            caught_up = step_no is None or last_step >= step_no or fails >= 3
+            if (training_done and caught_up) or ctx.stop_requested.is_set():
                 return
-            ctx.stop_requested.wait(interval)
+            ctx.stop_requested.wait(interval if fails == 0 else min(interval, 2.0))
     finally:
         if writer is not None:
             writer.close()

@@ -164,7 +164,17 @@ class CheckpointManager:
 
     def _gc(self, pending_step: int | None = None) -> None:
         import shutil
+        import sys
 
+        # Single-writer deletion: in the collective-save regime every data
+        # node calls save() and would rmtree the same step dirs on shared
+        # storage concurrently — a half-deleted dir can transiently look
+        # like the newest committed checkpoint to a concurrent reader
+        # (restore_latest / the evaluator).  All processes compute the same
+        # keep-K set, so only process 0 deletes.
+        jax = sys.modules.get("jax")
+        if jax is not None and jax.process_count() > 1 and jax.process_index() != 0:
+            return
         # Only committed dirs appear in _step_dirs; an async save still in
         # flight is invisible, so count it explicitly (``pending_step``) or
         # the keep-K window would run one checkpoint too large.

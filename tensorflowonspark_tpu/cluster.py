@@ -21,6 +21,7 @@ TPU-native deltas (BASELINE.json:5, SURVEY.md §2.3):
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import logging
 import os
@@ -266,6 +267,13 @@ class TPUCluster:
             for t in threads:
                 t.join()
         self._raise_node_errors()
+        if errors:
+            # A worker that failed AFTER its last partition was collected
+            # (e.g. send_eof) never trips the consumer loop's error check —
+            # surface it here or the node silently misses its EOF and stalls
+            # in next_batch until shutdown's kill timeout.
+            raise RuntimeError(f"inference worker failed after all results were "
+                               f"collected: {errors[0]}") from errors[0]
 
     # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
 
@@ -306,7 +314,29 @@ class TPUCluster:
                                 # and closed its data plane before EOF landed.
                                 logger.debug("node %d exited before EOF on %r",
                                              executor_id, qname)
-                            else:
+                                continue
+                            # The cached client's socket may have died with an
+                            # earlier timed-out call; this EOF is what unblocks
+                            # the node's next_batch, so retry once on a FRESH
+                            # connection before giving up.  One-shot socket
+                            # client: no shm-ring negotiation just to deliver
+                            # a ~20-byte EOF frame during teardown.
+                            stale = self._clients.pop(executor_id, None)
+                            if stale is not None:
+                                with contextlib.suppress(Exception):
+                                    stale.close()
+                            try:
+                                meta = self.cluster_info[executor_id]
+                                retry = DataClient(meta["host"], meta["data_port"],
+                                                   self.authkey, prefer_ring=False,
+                                                   call_timeout=30.0,
+                                                   stall_timeout=30.0)
+                                try:
+                                    retry.send_eof(qname)
+                                finally:
+                                    with contextlib.suppress(Exception):
+                                        retry.close()
+                            except Exception:
                                 logger.warning(
                                     "could not send EOF to node %d queue %r",
                                     executor_id, qname, exc_info=True)

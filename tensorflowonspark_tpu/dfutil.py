@@ -12,6 +12,7 @@ lists unless the schema marks them scalar.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import glob as _glob
 import json
@@ -137,22 +138,41 @@ def save_as_tfrecords(data: PartitionedDataset, output_dir: str, schema: Schema 
     suffix; readers auto-detect)."""
     output_dir = resolve_uri(output_dir)
     os.makedirs(output_dir, exist_ok=True)
-    # Clobber semantics: a re-save replaces the directory's shard set.  With
-    # compression the shard NAMES change (.gz suffix), so stale shards from
-    # a previous save must be removed or shard_files() would return both
-    # generations and every row would load twice.
+    # Crash-safe clobber semantics: a re-save replaces the directory's shard
+    # set (with compression the shard NAMES change — .gz suffix — so stale
+    # shards must go or shard_files() would load both generations), but the
+    # previous generation must survive any mid-save failure (schema
+    # inference error, disk full, interrupt).  So: write the new generation
+    # under temp names invisible to shard_files()'s ``part-*`` glob, and
+    # only after every partition is fully written delete the old shards and
+    # rename the new ones into place.
+    for orphan in _glob.glob(os.path.join(output_dir, ".tmp-part-*")):
+        os.remove(orphan)  # uncommitted leftovers of an earlier crashed save
+    suffix = ".gz" if compression and compression.lower() == "gzip" else ""
+    tmp_final: list[tuple[str, str]] = []
+    try:
+        for p in range(data.num_partitions):
+            name = f"part-r-{p:05d}{suffix}"
+            tmp = os.path.join(output_dir, f".tmp-{name}")
+            with tfrecord.RecordWriter(tmp, compression=compression) as w:
+                for row in data.iter_partition(p):
+                    if schema is None:
+                        schema = infer_schema(row)
+                    w.write(to_example(row, schema))
+            tmp_final.append((tmp, os.path.join(output_dir, name)))
+        if schema is None:
+            raise ValueError("dataset is empty; cannot infer a schema")
+    except BaseException:
+        # includes the half-written shard whose writer raised (it is not in
+        # tmp_final yet); all .tmp-part-* here are ours and uncommitted
+        for tmp in _glob.glob(os.path.join(output_dir, ".tmp-part-*")):
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+        raise
     for stale in _glob.glob(os.path.join(output_dir, "part-*")):
         os.remove(stale)
-    suffix = ".gz" if compression and compression.lower() == "gzip" else ""
-    for p in range(data.num_partitions):
-        path = os.path.join(output_dir, f"part-r-{p:05d}{suffix}")
-        with tfrecord.RecordWriter(path, compression=compression) as w:
-            for row in data.iter_partition(p):
-                if schema is None:
-                    schema = infer_schema(row)
-                w.write(to_example(row, schema))
-    if schema is None:
-        raise ValueError("dataset is empty; cannot infer a schema")
+    for tmp, final in tmp_final:
+        os.replace(tmp, final)
     with open(os.path.join(output_dir, "_schema.json"), "w") as f:
         f.write(schema.to_json())
     return schema

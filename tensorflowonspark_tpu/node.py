@@ -278,14 +278,22 @@ def node_main(config: NodeConfig) -> int:
         # jax.distributed requires.
         import jax
 
-        from tensorflowonspark_tpu.utils.net import find_free_port
+        from tensorflowonspark_tpu.utils.net import bound_socket
 
         num_data = sum(1 for m in cluster_info if m["job_name"] != "evaluator")
-        port = find_free_port() if executor_id == 0 else -1
+        # The chief HOLDS the port bound through the whole reduce (the long,
+        # unbounded wait for peers) and releases it only at handoff to
+        # jax.distributed's coordinator service — no bind-then-release window
+        # a concurrent process could squat in (SURVEY.md §5.2 race class;
+        # SO_REUSEADDR lets jax re-bind immediately).
+        sock = bound_socket() if executor_id == 0 else None
+        port = sock.getsockname()[1] if sock is not None else -1
         port = int(client.reduce("jax_coordinator_port", port, kind="max",
                                  timeout=config.reservation_timeout,
                                  count=num_data))
         chief_host = cluster_info[0]["host"]
+        if sock is not None:
+            sock.close()  # handoff: jax's coordinator binds it next
         jax.distributed.initialize(
             coordinator_address=f"{chief_host}:{port}",
             num_processes=num_data,

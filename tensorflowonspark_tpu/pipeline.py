@@ -401,6 +401,21 @@ class TPUModel(TPUParams):
             raise ValueError(
                 f"scoring='sharded' needs at least one partition per node "
                 f"({data.num_partitions} partitions < {num_executors} nodes)")
+        # One-pass input read: capture rows WHILE they stream to the scoring
+        # nodes, so partitions are consumed exactly once (no double IO on
+        # file-backed datasets; consume-once generator partitions work).
+        captured: dict[int, list] = {}
+
+        def _tee(p: int):
+            def gen():
+                rows = captured[p] = []
+                for row in data.iter_partition(p):
+                    rows.append(row)
+                    yield row
+
+            return gen
+
+        tee_data = PartitionedDataset([_tee(p) for p in range(data.num_partitions)])
         cluster = _cluster.run(
             sharded_bundle_inference_loop if sharded else bundle_inference_loop,
             args,
@@ -416,13 +431,16 @@ class TPUModel(TPUParams):
         try:
             # sharded scoring REQUIRES eager EOF: a node whose share ran out
             # keeps joining the global SPMD rounds until its peers finish
-            pred_parts = cluster.inference(data, flat=False,
+            pred_parts = cluster.inference(tee_data, flat=False,
                                            eof_when_done=sharded)
         finally:
             cluster.shutdown()
         parts = []
         for p, preds in enumerate(pred_parts):
-            rows = list(data.iter_partition(p))
+            rows = captured.get(p)
+            if rows is None:
+                raise RuntimeError(f"partition {p} produced predictions but was "
+                                   "never streamed (tee invariant violated)")
             if len(preds) != len(rows):
                 raise RuntimeError(
                     f"partition {p}: {len(preds)} predictions for {len(rows)} rows "

@@ -99,6 +99,33 @@ def test_resave_with_different_compression_clobbers(tmp_path):
     assert len(back) == 4  # no duplicated generations
 
 
+def test_failed_resave_preserves_previous_generation(tmp_path):
+    """A crash mid-save must not destroy the previous dataset generation
+    (advisor r4 medium): new shards are written under temp names and only
+    renamed into place after every partition committed."""
+    import glob
+    import os
+
+    rows = [{"x": [float(i)], "label": i} for i in range(4)]
+    data = PartitionedDataset.from_iterable(rows, 2)
+    dfutil.save_as_tfrecords(data, str(tmp_path / "d"))
+    before = sorted(os.path.basename(s) for s in dfutil.shard_files(str(tmp_path / "d")))
+
+    def poison():
+        yield {"x": [9.0], "label": 9}
+        raise IOError("disk full mid-save")
+
+    bad = PartitionedDataset([lambda: iter([{"x": [8.0], "label": 8}]), poison])
+    with pytest.raises(IOError, match="disk full"):
+        dfutil.save_as_tfrecords(bad, str(tmp_path / "d"))
+    # old generation fully intact, readable, and no temp litter
+    shards = dfutil.shard_files(str(tmp_path / "d"))
+    assert sorted(os.path.basename(s) for s in shards) == before
+    back = [r for s in shards for r in dfutil.read_shard(s, dfutil.read_schema(str(tmp_path / "d")))]
+    assert sorted(r["label"] for r in back) == [0, 1, 2, 3]
+    assert glob.glob(str(tmp_path / "d" / ".tmp-part-*")) == []
+
+
 class TestShardColumns:
     def _write(self, tmp_path, rows, partitions=1):
         data = PartitionedDataset.from_iterable(rows, partitions)
