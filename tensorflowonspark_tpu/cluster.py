@@ -76,6 +76,7 @@ class TPUCluster:
         input_mode: InputMode,
         queues: Sequence[str],
         feed_timeout: float,
+        heartbeat_interval: float = 2.0,
     ):
         self.coordinator = coordinator
         self.launcher = launcher
@@ -85,11 +86,54 @@ class TPUCluster:
         self.queues = queues
         self.input_qnames = [q for q in queues if q not in ("output", "error")]
         self.feed_timeout = feed_timeout
+        self.heartbeat_interval = heartbeat_interval
         self._clients: dict[int, DataClient] = {}
         self._shutdown_done = False
         # Feedable nodes: everything except the evaluator (the reference also
         # excluded ps nodes; we have none).
         self._feed_ids = [m["executor_id"] for m in cluster_info if m["job_name"] != "evaluator"]
+        # Dead-node monitor (SURVEY.md §5.3 — the role Spark played for the
+        # reference: the driver NOTICES executor death instead of waiting for
+        # a feed/barrier/collective timeout to expire).  A node whose
+        # heartbeat goes silent past the window is recorded as a node error,
+        # and the stop signal both aborts in-flight control-plane
+        # barriers/reduces and tells surviving nodes to stop — so blocked
+        # train()/inference() calls unblock within seconds, not
+        # feed_timeout.  Clean exits deregister first and are never flagged.
+        self._dead_after = _env_float("TOS_DEAD_NODE_TIMEOUT",
+                                      max(12.0, 6.0 * heartbeat_interval))
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
+                                         name="dead-node-monitor")
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        poll = max(1.0, self.heartbeat_interval)
+        while not self._monitor_stop.wait(poll):
+            dead = self.coordinator.dead_nodes(self._dead_after)
+            if not dead:
+                continue
+            # Role-aware escalation: the evaluator is an optional SIDECAR —
+            # it participates in no feed and no collective, so its death
+            # must not abort training (reference parity: a failed auxiliary
+            # executor didn't fail the job).  Data-node death fails the job.
+            dead_data = [i for i in dead if i in self._feed_ids]
+            dead_eval = [i for i in dead if i not in self._feed_ids]
+            if dead_eval:
+                logger.warning("evaluator node(s) %s stopped heartbeating; "
+                               "training continues without them", dead_eval)
+                self.coordinator.forget(dead_eval)
+            if dead_data:
+                logger.error("nodes %s stopped heartbeating (>%.0fs); failing "
+                             "in-flight work and signalling stop",
+                             dead_data, self._dead_after)
+                self.coordinator.mark_dead(dead_data)
+                self.coordinator.signal_stop()
+                return
+
+    def dead_nodes(self) -> list[int]:
+        """Executor ids currently past the heartbeat window (diagnostic)."""
+        return self.coordinator.dead_nodes(self._dead_after)
 
     # -- data-plane connections ---------------------------------------------
 
@@ -281,6 +325,10 @@ class TPUCluster:
         """Send end-of-feed, join node processes, propagate node errors."""
         if self._shutdown_done:
             return
+        # Stop the dead-node monitor first: shutdown's own escalation
+        # (join -> stop -> terminate) owns failure handling from here, and
+        # nodes it terminates must not be re-reported as deaths.
+        self._monitor_stop.set()
         try:
             # DIRECT-mode map_funs never consume the feed; EOF would just open
             # pointless connections to nodes that may already have exited.
@@ -344,16 +392,40 @@ class TPUCluster:
                 time.sleep(grace_secs)
             # Politely wait for map_funs to finish; only then escalate.  The
             # stop flag breaks in-flight barriers/reduces, so raising it early
-            # would abort healthy nodes mid-collective.
+            # would abort healthy nodes mid-collective.  The wait is
+            # DEATH-AWARE: if a node stops heartbeating mid-join, survivors
+            # may be wedged in a collective with the dead peer forever —
+            # waiting out the full polite timeout would just delay the
+            # inevitable escalation (SURVEY.md §5.3 prompt fail-fast).
             forced = False
-            if not self.launcher.join(timeout):
-                alive = self.launcher.alive()
-                logger.warning("nodes %s still running after %.0fs; signalling stop", alive, timeout)
-                self.coordinator.signal_stop()  # heartbeats tell stragglers to stop
-                if not self.launcher.join(15.0):
-                    forced = True
-                    logger.warning("nodes %s ignored stop; terminating", self.launcher.alive())
-                    self.launcher.terminate()
+            death_detected = False
+            deadline = time.monotonic() + timeout
+            while True:
+                slice_ = min(2.0, max(0.05, deadline - time.monotonic()))
+                if self.launcher.join(slice_):
+                    break
+                dead = self.coordinator.dead_nodes(self._dead_after)
+                dead_eval = [i for i in dead if i not in self._feed_ids]
+                if dead_eval:
+                    # sidecar death stays non-fatal even during shutdown
+                    logger.warning("evaluator node(s) %s died during shutdown", dead_eval)
+                    self.coordinator.forget(dead_eval)
+                dead = [i for i in dead if i in self._feed_ids]
+                if dead:
+                    death_detected = True
+                    logger.warning("nodes %s died during shutdown; escalating now", dead)
+                    self.coordinator.mark_dead(dead)
+                if death_detected or time.monotonic() >= deadline:
+                    alive = self.launcher.alive()
+                    logger.warning("nodes %s still running; signalling stop", alive)
+                    self.coordinator.signal_stop()  # heartbeats tell stragglers to stop
+                    # with a confirmed death, survivors wedged in collectives
+                    # never drain — keep the post-stop grace short
+                    if not self.launcher.join(5.0 if death_detected else 15.0):
+                        forced = True
+                        logger.warning("nodes %s ignored stop; terminating", self.launcher.alive())
+                        self.launcher.terminate()
+                    break
             for c in self._clients.values():
                 c.close()
             self._raise_node_errors()
@@ -524,4 +596,5 @@ def run(
         coordinator.stop()
         raise
     logger.info("cluster up: %s", [(m["executor_id"], m["job_name"]) for m in cluster_info])
-    return TPUCluster(coordinator, launcher, cluster_info, authkey, input_mode, queues, feed_timeout)
+    return TPUCluster(coordinator, launcher, cluster_info, authkey, input_mode,
+                      queues, feed_timeout, heartbeat_interval)

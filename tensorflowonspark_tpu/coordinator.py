@@ -215,9 +215,30 @@ class CoordinatorServer:
             return list(self._errors)
 
     def dead_nodes(self, heartbeat_timeout: float) -> list[int]:
+        """Nodes whose heartbeat went silent (deregistered nodes excluded)."""
         now = time.monotonic()
         with self._lock:
             return [i for i, t in self._last_seen.items() if now - t > heartbeat_timeout]
+
+    def forget(self, executor_ids: list[int]) -> None:
+        """Stop liveness-tracking nodes WITHOUT recording an error (used for
+        non-fatal sidecar deaths, e.g. the evaluator)."""
+        with self._lock:
+            for i in executor_ids:
+                self._last_seen.pop(i, None)
+
+    def mark_dead(self, executor_ids: list[int]) -> None:
+        """Record heartbeat-silent nodes as node errors (driver monitor path)
+        and stop tracking them, so one death is reported exactly once."""
+        with self._lock:
+            for i in executor_ids:
+                self._last_seen.pop(i, None)
+                self._errors.append({
+                    "executor_id": i,
+                    "traceback": (f"node {i} stopped heartbeating (process died "
+                                  "or host unreachable); detected by driver "
+                                  "monitor (SURVEY.md §5.3)"),
+                })
 
     def signal_stop(self) -> None:
         """Make subsequent heartbeats tell nodes to stop (zombie-free teardown)."""
@@ -249,8 +270,18 @@ class CoordinatorServer:
                 return {"ok": True}
             if op == "heartbeat":
                 with self._lock:
-                    self._last_seen[msg["executor_id"]] = time.monotonic()
+                    # a deregistered (cleanly exited) node sends no further
+                    # beats; never resurrect one from a late in-flight ping
+                    if msg["executor_id"] in self._last_seen:
+                        self._last_seen[msg["executor_id"]] = time.monotonic()
                 return {"ok": True, "stop": self._stop_flag.is_set()}
+            if op == "deregister":
+                # node exiting deliberately (map_fun done, or error already
+                # reported): stop liveness tracking so the driver's dead-node
+                # monitor never flags a clean exit as a death
+                with self._lock:
+                    self._last_seen.pop(msg["executor_id"], None)
+                return {"ok": True}
             if op == "error":
                 with self._lock:
                     self._errors.append({"executor_id": msg.get("executor_id"), "traceback": msg.get("traceback", "")})
@@ -392,6 +423,35 @@ class CoordinatorClient:
                         "timeout": timeout, "count": count})
         )["result"]
 
+    def reduce_begin(self, name: str, value: Any, kind: str = "gather",
+                     timeout: float = 300.0, count: int | None = None):
+        """Pipelined reduce: send this participant's value NOW, collect the
+        result later via the returned zero-arg callable.
+
+        Lets a caller overlap the control-plane round-trip with its own work
+        (e.g. a training step) instead of blocking one RTT per global step
+        (SURVEY.md §5.8-3).  The client lock is HELD from begin to finish —
+        strict request-reply ordering on the socket — so run pipelined
+        reduces on a dedicated connection, never on a client shared with
+        other mid-flight operations."""
+        self._lock.acquire()
+        sent = False
+        try:
+            _send_msg(self._sock, {"op": "reduce", "name": name, "value": value,
+                                   "kind": kind, "timeout": timeout, "count": count})
+            sent = True
+        finally:
+            if not sent:
+                self._lock.release()
+
+        def finish() -> Any:
+            try:
+                return self._check(_recv_msg(self._sock))["result"]
+            finally:
+                self._lock.release()
+
+        return finish
+
     def next_collective_name(self, prefix: str) -> str:
         """Locally-generated unique name; callers must use it SPMD-consistently."""
         self._gen += 1
@@ -407,6 +467,10 @@ class CoordinatorClient:
 
     def report_error(self, executor_id: int, traceback_str: str) -> None:
         self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})
+
+    def deregister(self, executor_id: int) -> None:
+        """Announce a deliberate exit (stops dead-node tracking for this id)."""
+        self._call({"op": "deregister", "executor_id": executor_id})
 
     def request_stop(self) -> None:
         self._call({"op": "stop"})

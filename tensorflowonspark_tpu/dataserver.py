@@ -145,6 +145,27 @@ class DataServer:
         finally:
             conn.close()
 
+    def _put_responsive(self, q: queue.Queue, item: Any) -> tuple | None:
+        """Blocking put that stays responsive to terminate/stop.
+
+        A put against a full queue whose consumer has wedged in user code
+        (not the feed, not a barrier) must not pin the driver's feed worker
+        for the whole ``feed_timeout``: poll the terminating state in short
+        slices so a stop signal drains the feed within ~0.5s.  Returns None
+        when the item was queued, or the reply tuple to send instead."""
+        deadline = _monotonic() + self.feed_timeout
+        while True:
+            if self.queues.get("state") == "terminating":
+                return ("ok", "terminating")
+            remaining = deadline - _monotonic()
+            if remaining <= 0:
+                return ("err", f"feed timeout after {self.feed_timeout}s (consumer stalled?)")
+            try:
+                q.put(item, block=True, timeout=min(0.5, remaining))
+                return None
+            except queue.Full:
+                continue
+
     def _handle(self, msg: tuple) -> tuple:
         op = msg[0]
         if op == "feed":
@@ -153,16 +174,14 @@ class DataServer:
                 return ("ok", "terminating")  # fast-drain: drop silently
             q = self.queues.get_queue(qname)
             for item in items:
-                try:
-                    q.put(item, block=True, timeout=self.feed_timeout)
-                except queue.Full:
-                    return ("err", f"feed timeout after {self.feed_timeout}s (consumer stalled?)")
+                state = self._put_responsive(q, item)
+                if state is not None:
+                    return state
             return ("ok", "running")
         if op == "end_partition":
             # data-integrity marker mid-stream: bounded wait, surface stalls
-            try:
-                self.queues.get_queue(msg[1]).put(EndPartition(), block=True, timeout=self.feed_timeout)
-            except queue.Full:
+            state = self._put_responsive(self.queues.get_queue(msg[1]), EndPartition())
+            if state is not None and state[0] == "err":
                 return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
             return ("ok",)
         if op == "eof":

@@ -82,6 +82,39 @@ def consensus_with_eval(args, ctx):
         f.write(str(rounds))
 
 
+def paced_sum_eval_waits(args, ctx):
+    """Data nodes drain the feed slowly (paced per batch); the evaluator
+    sidecar just waits for stop — the evaluator-death-is-non-fatal test
+    kills it mid-train and training must still complete."""
+    if ctx.job_name == "evaluator":
+        ctx.stop_requested.wait(600)
+        return
+    feed = ctx.get_data_feed(train_mode=True)
+    total, count = 0.0, 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch_size"])
+        total += sum(batch)
+        count += len(batch)
+        time.sleep(args.get("delay", 0.05))
+    with open(os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt"), "w") as f:
+        f.write(f"{total} {count}")
+
+
+def batch_then_barrier(args, ctx):
+    """Consume one batch, then wait at a barrier before draining the rest.
+    The node named by ``hang_id`` wedges BEFORE the barrier (simulating
+    death mid-compute once the test kills it), so the barrier never
+    completes naturally; only the driver's dead-node-monitor stop signal
+    breaks the survivor out."""
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(args["n"])
+    if ctx.executor_id == args.get("hang_id", -1):
+        time.sleep(600)  # killed mid-"compute" by the test
+    ctx.barrier("sync", timeout=300.0)
+    while not feed.should_stop():
+        feed.next_batch(args["n"])
+
+
 def writes_role(args, ctx):
     out = os.path.join(args["out_dir"], f"role_{ctx.executor_id}.txt")
     with open(out, "w") as f:

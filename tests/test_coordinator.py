@@ -197,3 +197,68 @@ def test_dead_node_detection():
     assert server.dead_nodes(heartbeat_timeout=0.15) == []
     c.close()
     server.stop()
+
+
+def test_reduce_begin_pipelined():
+    """Pipelined votes resolve to the same result as sync votes and may be
+    mixed with them in one generation (the batch-iterator's active hosts
+    pipeline while dry hosts vote synchronously)."""
+    server = CoordinatorServer(expected=2)
+    addr = server.start()
+    results = {}
+
+    def active_host():
+        c = CoordinatorClient(addr)
+        c.register({})
+        pending = None
+        for r in range(5):
+            if pending is not None:
+                results[("active", r - 1)] = pending()
+            pending = c.reduce_begin(f"v:{r}", r >= 4, kind="all", timeout=10, count=2)
+        results[("active", 4)] = pending()
+        c.close()
+
+    def dry_host():
+        c = CoordinatorClient(addr)
+        c.register({})
+        for r in range(5):
+            results[("dry", r)] = c.reduce(f"v:{r}", r >= 4, kind="all",
+                                           timeout=10, count=2)
+        c.close()
+
+    ts = [threading.Thread(target=active_host), threading.Thread(target=dry_host)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    server.stop()
+    for r in range(5):
+        want = r >= 4  # all-reduce of (r>=4, r>=4)
+        assert results[("active", r)] is want
+        assert results[("dry", r)] is want
+
+
+def test_deregister_and_mark_dead():
+    """Clean exits deregister and are never flagged; mark_dead records one
+    error per death and stops tracking, and a late in-flight heartbeat
+    cannot resurrect a deregistered node."""
+    server = CoordinatorServer(expected=2)
+    addr = server.start()
+    c0, c1 = CoordinatorClient(addr), CoordinatorClient(addr)
+    c0.register({})
+    c1.register({})
+    c0.deregister(0)
+    time.sleep(0.2)
+    assert server.dead_nodes(heartbeat_timeout=0.1) == [1]  # 0 exited cleanly
+    c0.heartbeat(0)  # late ping after deregister: must not resurrect
+    assert server.dead_nodes(heartbeat_timeout=10.0) == []
+    time.sleep(0.2)
+    assert server.dead_nodes(heartbeat_timeout=0.1) == [1]
+    server.mark_dead([1])
+    assert server.dead_nodes(heartbeat_timeout=0.0) == []  # reported once
+    errs = server.errors()
+    assert len(errs) == 1 and errs[0]["executor_id"] == 1
+    assert "stopped heartbeating" in errs[0]["traceback"]
+    c0.close()
+    c1.close()
+    server.stop()
