@@ -107,27 +107,32 @@ class TPUCluster:
                                          name="dead-node-monitor")
         self._monitor.start()
 
+    def _record_deaths(self) -> list[int]:
+        """Role-aware death bookkeeping, shared by the monitor thread and
+        shutdown's death-aware join.  The evaluator is an optional SIDECAR —
+        no feed, no collectives — so its death is logged and forgotten
+        (training continues; reference parity: a failed auxiliary executor
+        didn't fail the job).  Data-node deaths are recorded as node errors
+        (idempotently) and returned for the caller to escalate on."""
+        dead = self.coordinator.dead_nodes(self._dead_after)
+        dead_eval = [i for i in dead if i not in self._feed_ids]
+        if dead_eval:
+            logger.warning("evaluator node(s) %s stopped heartbeating; "
+                           "training continues without them", dead_eval)
+            self.coordinator.forget(dead_eval)
+        dead_data = [i for i in dead if i in self._feed_ids]
+        if dead_data:
+            self.coordinator.mark_dead(dead_data)
+        return dead_data
+
     def _monitor_loop(self) -> None:
         poll = max(1.0, self.heartbeat_interval)
         while not self._monitor_stop.wait(poll):
-            dead = self.coordinator.dead_nodes(self._dead_after)
-            if not dead:
-                continue
-            # Role-aware escalation: the evaluator is an optional SIDECAR —
-            # it participates in no feed and no collective, so its death
-            # must not abort training (reference parity: a failed auxiliary
-            # executor didn't fail the job).  Data-node death fails the job.
-            dead_data = [i for i in dead if i in self._feed_ids]
-            dead_eval = [i for i in dead if i not in self._feed_ids]
-            if dead_eval:
-                logger.warning("evaluator node(s) %s stopped heartbeating; "
-                               "training continues without them", dead_eval)
-                self.coordinator.forget(dead_eval)
+            dead_data = self._record_deaths()
             if dead_data:
                 logger.error("nodes %s stopped heartbeating (>%.0fs); failing "
                              "in-flight work and signalling stop",
                              dead_data, self._dead_after)
-                self.coordinator.mark_dead(dead_data)
                 self.coordinator.signal_stop()
                 return
 
@@ -404,17 +409,10 @@ class TPUCluster:
                 slice_ = min(2.0, max(0.05, deadline - time.monotonic()))
                 if self.launcher.join(slice_):
                     break
-                dead = self.coordinator.dead_nodes(self._dead_after)
-                dead_eval = [i for i in dead if i not in self._feed_ids]
-                if dead_eval:
-                    # sidecar death stays non-fatal even during shutdown
-                    logger.warning("evaluator node(s) %s died during shutdown", dead_eval)
-                    self.coordinator.forget(dead_eval)
-                dead = [i for i in dead if i in self._feed_ids]
+                dead = self._record_deaths()
                 if dead:
                     death_detected = True
                     logger.warning("nodes %s died during shutdown; escalating now", dead)
-                    self.coordinator.mark_dead(dead)
                 if death_detected or time.monotonic() >= deadline:
                     alive = self.launcher.alive()
                     logger.warning("nodes %s still running; signalling stop", alive)

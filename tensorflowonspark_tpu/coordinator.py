@@ -229,10 +229,14 @@ class CoordinatorServer:
 
     def mark_dead(self, executor_ids: list[int]) -> None:
         """Record heartbeat-silent nodes as node errors (driver monitor path)
-        and stop tracking them, so one death is reported exactly once."""
+        and stop tracking them.  Idempotent: the error is appended only when
+        the node was still being tracked, so the monitor thread and
+        shutdown's death-aware join racing on the same death report it
+        exactly once."""
         with self._lock:
             for i in executor_ids:
-                self._last_seen.pop(i, None)
+                if self._last_seen.pop(i, None) is None:
+                    continue
                 self._errors.append({
                     "executor_id": i,
                     "traceback": (f"node {i} stopped heartbeating (process died "

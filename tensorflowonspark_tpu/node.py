@@ -339,8 +339,17 @@ def node_main(config: NodeConfig) -> int:
             except Exception:
                 time.sleep(0.5 * (attempt + 1))
         if hb_client is None:
-            logger.warning("heartbeat channel could not connect after retries; "
-                           "stopping this node (driver would flag it dead)")
+            # Must NOT stop silently: a clean exit here would deregister and
+            # drop this node's partitions with no error anywhere (silent
+            # data loss).  Report through the main client (thread-safe) so
+            # train()/shutdown() raise, THEN drain.
+            msg = ("heartbeat channel could not connect after retries; "
+                   "node cannot participate in liveness tracking")
+            logger.error(msg)
+            try:
+                client.report_error(executor_id, msg)
+            except Exception:
+                pass
             _enter_stop_state()
             return
         failures = 0

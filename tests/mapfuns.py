@@ -82,6 +82,39 @@ def consensus_with_eval(args, ctx):
         f.write(str(rounds))
 
 
+def read_referenced_shards(args, ctx):
+    """Consume file REFERENCES from the feed and read the shards locally
+    (the Spark data-locality analogue, data.from_file_references): sums the
+    'label' column of every row in every referenced TFRecord shard."""
+    from tensorflowonspark_tpu import dfutil
+
+    feed = ctx.get_data_feed(train_mode=True)
+    total, rows = 0, 0
+    while not feed.should_stop():
+        for path in feed.next_batch(4):
+            for row in dfutil.read_shard(path, dfutil.read_schema(os.path.dirname(path))):
+                total += int(row["label"])
+                rows += 1
+    out = os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{total} {rows}")
+
+
+def sum_lens(args, ctx):
+    """Drain the feed summing item LENGTHS (bytes rows) — the fan-out
+    throughput bench's consumer."""
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    count = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch_size"])
+        total += sum(len(x) for x in batch)
+        count += len(batch)
+    out = os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{total} {count}")
+
+
 def paced_sum_eval_waits(args, ctx):
     """Data nodes drain the feed slowly (paced per batch); the evaluator
     sidecar just waits for stop — the evaluator-death-is-non-fatal test

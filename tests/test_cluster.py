@@ -65,6 +65,44 @@ def test_train_streaming_sums(tmp_path):
     assert totals == 2 * sum(range(100))
 
 
+def test_train_streams_file_references(tmp_path):
+    """STREAMING a dataset of file REFERENCES (VERDICT r4 item 5 stretch):
+    the driver ships shard paths, each node reads its shards' bytes itself
+    — the Spark data-locality analogue.  Every row of every shard must be
+    consumed exactly once across the cluster."""
+    from tensorflowonspark_tpu import dfutil
+
+    rows = [{"x": [float(i)], "label": i} for i in range(60)]
+    data = tos.PartitionedDataset.from_iterable(rows, 6)
+    dfutil.save_as_tfrecords(data, str(tmp_path / "shards"))
+
+    refs = tos.PartitionedDataset.from_file_references(
+        str(tmp_path / "shards" / "part-*"), num_partitions=2)
+    assert refs.num_partitions == 2
+    # only paths travel the wire
+    assert all(isinstance(p, str) for part in (0, 1)
+               for p in refs.iter_partition(part))
+
+    out = tmp_path / "out"
+    out.mkdir()
+    cluster = tos.run(
+        mapfuns.read_referenced_shards,
+        {"out_dir": str(out)},
+        num_executors=2,
+        input_mode=InputMode.STREAMING,
+        reservation_timeout=60,
+    )
+    cluster.train(refs, num_epochs=1)
+    cluster.shutdown()
+    total, count = 0, 0
+    for i in range(2):
+        t, c = (out / f"node_{i}.txt").read_text().split()
+        total += int(t)
+        count += int(c)
+    assert count == 60                 # every row of every shard, exactly once
+    assert total == sum(range(60))
+
+
 def test_inference_ordered_exact(tmp_path):
     cluster = tos.run(
         mapfuns.echo_inference,
