@@ -24,6 +24,22 @@ from jax.sharding import PartitionSpec as P
 from tensorflowonspark_tpu.ops.attention import match_vma
 
 
+def _validate_stage_inputs(stage_params: Any, x: jax.Array, n_stages: int,
+                           n_microbatches: int) -> None:
+    """Shared gpipe/1F1B preconditions: microbatch divisibility and a
+    stage-stacked params layout (every leaf leading dim == n_stages)."""
+    if x.shape[0] % n_microbatches:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_microbatches {n_microbatches}")
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
+        shape = getattr(leaf, "shape", None)
+        if not shape or shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has shape "
+                f"{shape}, expected leading dim n_stages={n_stages} "
+                f"(use stack_stages)")
+
+
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
           x: jax.Array, *, mesh, n_microbatches: int, axis_name: str = "pp"):
     """Run ``x`` through a pipeline of stages; returns the final activations.
@@ -33,17 +49,19 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
     - ``stage_fn(params_i, mb) -> mb``: one stage's computation; activation
       shapes must be identical between stages (the inter-stage wire format).
     - ``x``: global batch ``[B, …]`` with ``B % n_microbatches == 0``.
+
+    **Bubble accounting.**  With ``m`` microbatches over ``s`` stages the
+    schedule runs ``m + s - 1`` ticks of which each stage computes on ``m``,
+    so utilisation is ``m / (m + s - 1)`` (bubble fraction
+    ``(s-1)/(m+s-1)``); the backward scan XLA derives doubles both numbers,
+    leaving the fraction unchanged.  Memory: ``jax.grad`` through the scan
+    saves every tick's activations — O(m) microbatch residuals per stage.
+    When that dominates, use :func:`pipeline_1f1b`, which caps in-flight
+    residuals at ``s - stage_index`` and recomputes stage forwards in the
+    backward (GPipe-remat style), at the same bubble fraction.
     """
     n_stages = mesh.shape[axis_name]
-    if x.shape[0] % n_microbatches:
-        raise ValueError(f"batch {x.shape[0]} not divisible by "
-                         f"n_microbatches {n_microbatches}")
-    for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
-        if getattr(leaf, "ndim", 0) == 0 or leaf.shape[0] != n_stages:
-            raise ValueError(
-                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
-                f"dim {leaf.shape[0]}, expected n_stages={n_stages} "
-                f"(use stack_stages)")
+    _validate_stage_inputs(stage_params, x, n_stages, n_microbatches)
 
     def body(params, xb):
         params = jax.tree.map(lambda a: a[0], params)   # local stage's slice
@@ -88,6 +106,131 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
         check_vma=False,
     )
     return mapped(stage_params, x)
+
+
+def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any, x: jax.Array, loss_fn: Callable,
+                  *, mesh, n_microbatches: int, targets: Any = None,
+                  axis_name: str = "pp"):
+    """One-forward-one-backward (PipeDream-flush) pipelined loss + grads.
+
+    Returns ``(loss, grads)``: ``loss`` is the mean of
+    ``loss_fn(y_mb[, tgt_mb])`` over microbatches, ``grads`` is
+    ``d loss / d stage_params`` in the same stage-stacked layout.
+
+    Versus differentiating :func:`gpipe` (which scans forward then lets XLA
+    reverse it), the backward here is *scheduled*: each stage alternates one
+    forward and one backward microbatch in steady state, so at most
+    ``s - stage_index`` forward residuals are ever in flight per stage
+    (O(s) memory, independent of ``m``) instead of O(m).  Only stage
+    *inputs* are saved; the backward recomputes the stage forward under
+    ``jax.vjp`` (activation recompute, the standard 1F1B-with-remat
+    trade: ~1.33x forward FLOPs).  The bubble fraction is GPipe's
+    ``(s-1)/(m+s-1)``; 1F1B moves the backward earlier, it does not shrink
+    the bubble.  Beyond-reference capability — the reference has no
+    pipeline parallelism at all (SURVEY.md §2.3).
+
+    Schedule (tick ``t``, stage ``i``, ``s`` stages, ``m`` microbatches):
+    forward ``k`` runs at ``t = i + 2k``, backward ``k`` at
+    ``t = 2s - 1 - i + 2k`` — disjoint by parity, producer always one tick
+    ahead of its consumer on both the forward and backward wires; last
+    backward lands at ``t = 2(m + s) - 3``.
+
+    ``stage_fn(params_i, mb) -> mb_out`` as in :func:`gpipe`;
+    ``loss_fn(y_mb)`` or ``loss_fn(y_mb, tgt_mb)`` (when ``targets`` — a
+    pytree of ``[B, …]`` arrays — is given) must return a scalar.
+    """
+    n_stages = mesh.shape[axis_name]
+    m = n_microbatches
+    _validate_stage_inputs(stage_params, x, n_stages, m)
+    has_tgts = targets is not None
+    tgts_in = targets if has_tgts else ()
+
+    def body(params, xb, tgts):
+        params = jax.tree.map(lambda a: a[0], params)
+        idx = jax.lax.axis_index(axis_name)
+        s = n_stages
+        mb = xb.shape[0] // m
+        xs = xb.reshape((m, mb) + xb.shape[1:])
+        tgts_mb = jax.tree.map(
+            lambda a: a.reshape((m, mb) + a.shape[1:]), tgts)
+        fwd_perm = [(i, i + 1) for i in range(s - 1)]
+        bwd_perm = [(i + 1, i) for i in range(s - 1)]
+
+        zero_mb = match_vma(jnp.zeros((mb,) + xb.shape[1:], jnp.float32), xb)
+
+        def tick(carry, t):
+            fwd_recv, bwd_recv, resid, grad_acc, loss_acc = carry
+            tf = t - idx
+            is_f = (tf >= 0) & (tf % 2 == 0) & (tf < 2 * m)
+            kf = jnp.clip(tf // 2, 0, m - 1)
+            tb = t - (2 * s - 1 - idx)
+            is_b = (tb >= 0) & (tb % 2 == 0) & (tb < 2 * m)
+            kb = jnp.clip(tb // 2, 0, m - 1)
+            x_in = jnp.where(idx == 0,
+                             jax.lax.dynamic_index_in_dim(xs, kf, keepdims=False),
+                             fwd_recv.astype(xb.dtype))
+
+            def fwd_branch(resid, grad_acc, loss_acc):
+                out = stage_fn(params, x_in)
+                resid = jax.lax.dynamic_update_index_in_dim(
+                    resid, x_in.astype(jnp.float32), kf % s, 0)
+                return (match_vma(out.astype(jnp.float32), xb), zero_mb,
+                        resid, grad_acc, loss_acc)
+
+            def bwd_branch(resid, grad_acc, loss_acc):
+                inp = jax.lax.dynamic_index_in_dim(
+                    resid, kb % s, keepdims=False).astype(xb.dtype)
+                out, vjp = jax.vjp(stage_fn, params, inp)
+                if has_tgts:
+                    tgt_k = jax.tree.map(
+                        lambda a: jax.lax.dynamic_index_in_dim(
+                            a, kb, keepdims=False), tgts_mb)
+                    lfn = lambda y: loss_fn(y, tgt_k)  # noqa: E731
+                else:
+                    lfn = loss_fn
+                lk, g_loss = jax.value_and_grad(lfn)(out)
+                g_out = jnp.where(idx == s - 1,
+                                  g_loss.astype(jnp.float32),
+                                  bwd_recv).astype(out.dtype)
+                g_par, g_in = vjp(g_out)
+                grad_acc = jax.tree.map(
+                    lambda acc, g: acc + g.astype(jnp.float32),
+                    grad_acc, g_par)
+                loss_acc = loss_acc + jnp.where(idx == s - 1, lk, 0.0)
+                return (zero_mb, match_vma(g_in.astype(jnp.float32), xb),
+                        resid, grad_acc, loss_acc)
+
+            def idle_branch(resid, grad_acc, loss_acc):
+                return zero_mb, zero_mb, resid, grad_acc, loss_acc
+
+            branch = jnp.where(is_f, 1, 0) + jnp.where(is_b, 2, 0)
+            send_f, send_b, resid, grad_acc, loss_acc = jax.lax.switch(
+                branch, [idle_branch, fwd_branch, bwd_branch],
+                resid, grad_acc, loss_acc)
+            fwd_recv = jax.lax.ppermute(send_f, axis_name, fwd_perm)
+            bwd_recv = jax.lax.ppermute(send_b, axis_name, bwd_perm)
+            return (fwd_recv, bwd_recv, resid, grad_acc, loss_acc), None
+
+        resid0 = match_vma(
+            jnp.zeros((s, mb) + xb.shape[1:], jnp.float32), xb)
+        grad0 = jax.tree.map(
+            lambda a: match_vma(jnp.zeros(a.shape, jnp.float32), xb), params)
+        loss0 = match_vma(jnp.float32(0.0), xb)
+        carry = (zero_mb, zero_mb, resid0, grad0, loss0)
+        carry, _ = jax.lax.scan(tick, carry, jnp.arange(2 * (m + s) - 2))
+        _, _, _, grad_acc, loss_acc = carry
+        loss = jax.lax.psum(loss_acc, axis_name) / m
+        grads = jax.tree.map(lambda a: (a / m)[None], grad_acc)
+        return loss, grads
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=(P(), P(axis_name)),
+        check_vma=False,
+    )
+    return mapped(stage_params, x, tgts_in)
 
 
 def stack_stages(param_trees: list) -> Any:

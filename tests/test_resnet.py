@@ -99,10 +99,12 @@ def test_fsdp_shardings_split_largest_divisible_dim():
     assert shardings["scalar"].spec == jax.sharding.PartitionSpec()
 
 
-@pytest.mark.slow
+@pytest.mark.dryrun
 def test_graft_entry_dryrun():
     """The driver's multichip gate runs this same entry point directly every
-    round; in-suite it is opt-in (`-m slow`) to keep the gate fast."""
+    round — the ONE test whose coverage is independently re-executed outside
+    the suite.  Opt-in (`-m dryrun`, ~90s: six full SPMD train-step compiles)
+    so the default gate can afford to include every other slow test."""
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
