@@ -30,6 +30,7 @@ from tensorflowonspark_tpu.parallel.tp import (  # noqa: F401
 )
 from tensorflowonspark_tpu.parallel.pp import (  # noqa: F401
     gpipe,
+    pipeline_1f1b,
     stack_stages,
     stage_shardings,
 )

@@ -157,7 +157,11 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
         fwd_perm = [(i, i + 1) for i in range(s - 1)]
         bwd_perm = [(i + 1, i) for i in range(s - 1)]
 
-        zero_mb = match_vma(jnp.zeros((mb,) + xb.shape[1:], jnp.float32), xb)
+        # Forward wire + residual buffer ride in the activation dtype (bf16
+        # stays bf16 — the O(s) residual cap is the schedule's selling
+        # point); only the gradient wire is f32.
+        zero_act = match_vma(jnp.zeros((mb,) + xb.shape[1:], xb.dtype), xb)
+        zero_grad = match_vma(jnp.zeros((mb,) + xb.shape[1:], jnp.float32), xb)
 
         def tick(carry, t):
             fwd_recv, bwd_recv, resid, grad_acc, loss_acc = carry
@@ -169,18 +173,18 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
             kb = jnp.clip(tb // 2, 0, m - 1)
             x_in = jnp.where(idx == 0,
                              jax.lax.dynamic_index_in_dim(xs, kf, keepdims=False),
-                             fwd_recv.astype(xb.dtype))
+                             fwd_recv)
 
             def fwd_branch(resid, grad_acc, loss_acc):
                 out = stage_fn(params, x_in)
                 resid = jax.lax.dynamic_update_index_in_dim(
-                    resid, x_in.astype(jnp.float32), kf % s, 0)
-                return (match_vma(out.astype(jnp.float32), xb), zero_mb,
+                    resid, x_in, kf % s, 0)
+                return (match_vma(out.astype(xb.dtype), xb), zero_grad,
                         resid, grad_acc, loss_acc)
 
             def bwd_branch(resid, grad_acc, loss_acc):
                 inp = jax.lax.dynamic_index_in_dim(
-                    resid, kb % s, keepdims=False).astype(xb.dtype)
+                    resid, kb % s, keepdims=False)
                 out, vjp = jax.vjp(stage_fn, params, inp)
                 if has_tgts:
                     tgt_k = jax.tree.map(
@@ -198,11 +202,11 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                     lambda acc, g: acc + g.astype(jnp.float32),
                     grad_acc, g_par)
                 loss_acc = loss_acc + jnp.where(idx == s - 1, lk, 0.0)
-                return (zero_mb, match_vma(g_in.astype(jnp.float32), xb),
+                return (zero_act, match_vma(g_in.astype(jnp.float32), xb),
                         resid, grad_acc, loss_acc)
 
             def idle_branch(resid, grad_acc, loss_acc):
-                return zero_mb, zero_mb, resid, grad_acc, loss_acc
+                return zero_act, zero_grad, resid, grad_acc, loss_acc
 
             branch = jnp.where(is_f, 1, 0) + jnp.where(is_b, 2, 0)
             send_f, send_b, resid, grad_acc, loss_acc = jax.lax.switch(
@@ -213,11 +217,11 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
             return (fwd_recv, bwd_recv, resid, grad_acc, loss_acc), None
 
         resid0 = match_vma(
-            jnp.zeros((s, mb) + xb.shape[1:], jnp.float32), xb)
+            jnp.zeros((s, mb) + xb.shape[1:], xb.dtype), xb)
         grad0 = jax.tree.map(
             lambda a: match_vma(jnp.zeros(a.shape, jnp.float32), xb), params)
         loss0 = match_vma(jnp.float32(0.0), xb)
-        carry = (zero_mb, zero_mb, resid0, grad0, loss0)
+        carry = (zero_act, zero_grad, resid0, grad0, loss0)
         carry, _ = jax.lax.scan(tick, carry, jnp.arange(2 * (m + s) - 2))
         _, _, _, grad_acc, loss_acc = carry
         loss = jax.lax.psum(loss_acc, axis_name) / m
