@@ -172,6 +172,74 @@ def test_1f1b_without_targets():
 
 
 @pytest.mark.slow
+def test_1f1b_transformer_blocks_match_sequential():
+    """Model-grade 1F1B: transformer Blocks as stages, the LM head and
+    cross-entropy folded into loss_fn (it sees the last stage's
+    activations).  Loss and block-param gradients must match sequential
+    autodiff of the same decomposition."""
+    from tensorflowonspark_tpu.models import transformer as tfm
+
+    d_model, n_heads, n_layers = 16, 4, 4
+    model = tfm.Transformer(vocab_size=32, d_model=d_model, n_layers=n_layers,
+                            n_heads=n_heads, attn_impl="xla",
+                            compute_dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 32, (8, 6)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    n_stages, per_stage = 2, 2
+    mesh = meshlib.make_mesh(jax.devices()[:n_stages], pp=n_stages)
+    block = tfm.Block(n_heads=n_heads, d_head=d_model // n_heads,
+                      d_ff=4 * d_model, attn_impl="xla",
+                      compute_dtype=jnp.float32)
+
+    def stage_tree(i):
+        return jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *(params[f"block_{i * per_stage + j}"] for j in range(per_stage)))
+
+    stacked = pplib.stack_stages([stage_tree(i) for i in range(n_stages)])
+
+    def pipe_stage(p, x):
+        for j in range(per_stage):
+            sub = jax.tree.map(lambda a: a[j], p)
+            x = block.apply({"params": sub}, x)
+        return x
+
+    import flax.linen as nn
+
+    embed = nn.Embed(32, d_model, dtype=jnp.float32)
+    h_in = embed.apply({"params": params["embed"]}, ids)
+    tgt = jnp.asarray(np.random.RandomState(1).randint(0, 32, (8, 6)),
+                      jnp.int32)
+
+    def head_loss(h, tgt_mb):
+        final = tfm.RMSNorm().apply({"params": params["final_norm"]}, h)
+        logits = nn.Dense(32, use_bias=False).apply(
+            {"params": params["lm_head"]}, final).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, tgt_mb[..., None],
+                                             axis=-1))
+
+    loss, grads = pplib.pipeline_1f1b(pipe_stage, stacked, h_in, head_loss,
+                                      mesh=mesh, n_microbatches=4,
+                                      targets=tgt)
+
+    def seq_loss(s):
+        h = h_in
+        for i in range(n_stages):
+            h = pipe_stage(jax.tree.map(lambda a: a[i], s), h)
+        return head_loss(h, tgt)
+
+    np.testing.assert_allclose(float(loss), float(seq_loss(stacked)),
+                               rtol=1e-5)
+    g_seq = jax.grad(seq_loss)(stacked)
+    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
 def test_gpipe_transformer_blocks_match_sequential():
     """Model-grade pipeline parallelism: real transformer Blocks as pipeline
     stages (2 stages x 2 blocks, embed/head outside the pipe — the classic
