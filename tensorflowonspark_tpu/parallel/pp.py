@@ -24,13 +24,9 @@ from jax.sharding import PartitionSpec as P
 from tensorflowonspark_tpu.ops.attention import match_vma
 
 
-def _validate_stage_inputs(stage_params: Any, x: jax.Array, n_stages: int,
-                           n_microbatches: int) -> None:
-    """Shared gpipe/1F1B preconditions: microbatch divisibility and a
-    stage-stacked params layout (every leaf leading dim == n_stages)."""
-    if x.shape[0] % n_microbatches:
-        raise ValueError(f"batch {x.shape[0]} not divisible by "
-                         f"n_microbatches {n_microbatches}")
+def _validate_stage_params(stage_params: Any, n_stages: int) -> None:
+    """Shared gpipe/1F1B precondition: a stage-stacked params layout
+    (every leaf leading dim == n_stages)."""
     for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
         shape = getattr(leaf, "shape", None)
         if not shape or shape[0] != n_stages:
@@ -61,7 +57,10 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
     backward (GPipe-remat style), at the same bubble fraction.
     """
     n_stages = mesh.shape[axis_name]
-    _validate_stage_inputs(stage_params, x, n_stages, n_microbatches)
+    _validate_stage_params(stage_params, n_stages)
+    if x.shape[0] % n_microbatches:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_microbatches {n_microbatches}")
 
     def body(params, xb):
         params = jax.tree.map(lambda a: a[0], params)   # local stage's slice
@@ -111,12 +110,20 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
 def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                   stage_params: Any, x: jax.Array, loss_fn: Callable,
                   *, mesh, n_microbatches: int, targets: Any = None,
-                  axis_name: str = "pp"):
+                  head_params: Any = None, with_input_grad: bool = False,
+                  axis_name: str = "pp", data_axis: str = "dp"):
     """One-forward-one-backward (PipeDream-flush) pipelined loss + grads.
 
-    Returns ``(loss, grads)``: ``loss`` is the mean of
-    ``loss_fn(y_mb[, tgt_mb])`` over microbatches, ``grads`` is
-    ``d loss / d stage_params`` in the same stage-stacked layout.
+    Returns ``(loss, grads[, head_grads][, dx])``: ``loss`` is the mean of
+    the per-microbatch losses, ``grads`` is ``d loss / d stage_params`` in
+    the same stage-stacked layout.  With ``head_params`` the loss head that
+    lives OUTSIDE the pipe (final norm + lm_head, the classic GPipe
+    placement) trains too: ``loss_fn(head_params, y_mb[, tgt_mb])`` and the
+    result gains ``head_grads``.  With ``with_input_grad=True`` the result
+    gains ``dx = d loss / d x`` ``[B, …]`` so an outside-the-pipe embedding
+    can backprop through the pipeline (``dx`` is the size of ``x`` itself —
+    it does not reintroduce the O(m) per-stage residuals this schedule
+    avoids).
 
     Versus differentiating :func:`gpipe` (which scans forward then lets XLA
     reverse it), the backward here is *scheduled*: each stage alternates one
@@ -137,16 +144,36 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     backward lands at ``t = 2(m + s) - 3``.
 
     ``stage_fn(params_i, mb) -> mb_out`` as in :func:`gpipe`;
-    ``loss_fn(y_mb)`` or ``loss_fn(y_mb, tgt_mb)`` (when ``targets`` — a
-    pytree of ``[B, …]`` arrays — is given) must return a scalar.
+    ``loss_fn([head_params, ]y_mb[, tgt_mb])`` (``tgt_mb`` present when
+    ``targets`` — a pytree of ``[B, …]`` arrays — is given) must return a
+    scalar.
+
+    Composes with data parallelism: when the mesh's ``data_axis`` (default
+    ``dp``) has size > 1, the batch (and ``targets``) shard over it, each dp
+    row runs its own pipeline on its shard, and stage/head grads and the
+    loss are averaged across rows — the global result equals a single
+    pipeline over the whole batch.
     """
     n_stages = mesh.shape[axis_name]
     m = n_microbatches
-    _validate_stage_inputs(stage_params, x, n_stages, m)
+    _validate_stage_params(stage_params, n_stages)
+    dp_size = dict(mesh.shape).get(data_axis, 1)
+    if x.shape[0] % (dp_size * m):
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {data_axis}-size x "
+            f"n_microbatches = {dp_size} x {m}")
+    batch_spec = P(data_axis) if dp_size > 1 else P()
     has_tgts = targets is not None
     tgts_in = targets if has_tgts else ()
+    has_head = head_params is not None
+    head_in = head_params if has_head else ()
 
-    def body(params, xb, tgts):
+    def _dp_mean(tree):
+        if dp_size == 1:
+            return tree
+        return jax.tree.map(lambda a: jax.lax.pmean(a, data_axis), tree)
+
+    def body(params, hp, xb, tgts):
         params = jax.tree.map(lambda a: a[0], params)
         idx = jax.lax.axis_index(axis_name)
         s = n_stages
@@ -164,7 +191,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
         zero_grad = match_vma(jnp.zeros((mb,) + xb.shape[1:], jnp.float32), xb)
 
         def tick(carry, t):
-            fwd_recv, bwd_recv, resid, grad_acc, loss_acc = carry
+            fwd_recv, bwd_recv, resid, grad_acc, loss_acc, hg_acc, dx_buf = carry
             tf = t - idx
             is_f = (tf >= 0) & (tf % 2 == 0) & (tf < 2 * m)
             kf = jnp.clip(tf // 2, 0, m - 1)
@@ -175,14 +202,14 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                              jax.lax.dynamic_index_in_dim(xs, kf, keepdims=False),
                              fwd_recv)
 
-            def fwd_branch(resid, grad_acc, loss_acc):
+            def fwd_branch(resid, grad_acc, loss_acc, hg_acc, dx_buf):
                 out = stage_fn(params, x_in)
                 resid = jax.lax.dynamic_update_index_in_dim(
                     resid, x_in, kf % s, 0)
                 return (match_vma(out.astype(xb.dtype), xb), zero_grad,
-                        resid, grad_acc, loss_acc)
+                        resid, grad_acc, loss_acc, hg_acc, dx_buf)
 
-            def bwd_branch(resid, grad_acc, loss_acc):
+            def bwd_branch(resid, grad_acc, loss_acc, hg_acc, dx_buf):
                 inp = jax.lax.dynamic_index_in_dim(
                     resid, kb % s, keepdims=False)
                 out, vjp = jax.vjp(stage_fn, params, inp)
@@ -190,51 +217,113 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                     tgt_k = jax.tree.map(
                         lambda a: jax.lax.dynamic_index_in_dim(
                             a, kb, keepdims=False), tgts_mb)
-                    lfn = lambda y: loss_fn(y, tgt_k)  # noqa: E731
                 else:
-                    lfn = loss_fn
-                lk, g_loss = jax.value_and_grad(lfn)(out)
-                g_out = jnp.where(idx == s - 1,
-                                  g_loss.astype(jnp.float32),
-                                  bwd_recv).astype(out.dtype)
+                    tgt_k = None
+                last = idx == s - 1
+                # The loss head runs ONLY on the last stage (lax.cond, no
+                # collectives inside) — an lm_head-sized loss would
+                # otherwise cost s x per backward tick, discarded on s-1
+                # stages.
+                if has_head:
+                    lfn = (lambda h, y: loss_fn(h, y, tgt_k)) if has_tgts \
+                        else (lambda h, y: loss_fn(h, y))
+
+                    def _head(hp, out):
+                        lk, (g_hp, g_l) = jax.value_and_grad(
+                            lfn, argnums=(0, 1))(hp, out)
+                        return (jnp.float32(lk),
+                                jax.tree.map(
+                                    lambda a: a.astype(jnp.float32), g_hp),
+                                g_l.astype(jnp.float32))
+
+                    def _skip(hp, out):
+                        return (jnp.float32(0.0),
+                                jax.tree.map(
+                                    lambda a: jnp.zeros(a.shape, jnp.float32),
+                                    hp),
+                                jnp.zeros(out.shape, jnp.float32))
+
+                    lk, g_hp, g_loss = jax.lax.cond(last, _head, _skip,
+                                                    hp, out)
+                    hg_acc = jax.tree.map(jnp.add, hg_acc, g_hp)
+                else:
+                    lfn = (lambda y: loss_fn(y, tgt_k)) if has_tgts \
+                        else loss_fn
+
+                    def _head(out):
+                        lk, g_l = jax.value_and_grad(lfn)(out)
+                        return jnp.float32(lk), g_l.astype(jnp.float32)
+
+                    def _skip(out):
+                        return (jnp.float32(0.0),
+                                jnp.zeros(out.shape, jnp.float32))
+
+                    lk, g_loss = jax.lax.cond(last, _head, _skip, out)
+                g_out = jnp.where(last, g_loss, bwd_recv).astype(out.dtype)
                 g_par, g_in = vjp(g_out)
                 grad_acc = jax.tree.map(
                     lambda acc, g: acc + g.astype(jnp.float32),
                     grad_acc, g_par)
-                loss_acc = loss_acc + jnp.where(idx == s - 1, lk, 0.0)
+                loss_acc = loss_acc + lk  # lk is zero off the last stage
+                if with_input_grad:
+                    dx_buf = jax.lax.dynamic_update_index_in_dim(
+                        dx_buf,
+                        jnp.where(idx == 0, g_in.astype(jnp.float32), 0.0),
+                        kb, 0)
                 return (zero_act, match_vma(g_in.astype(jnp.float32), xb),
-                        resid, grad_acc, loss_acc)
+                        resid, grad_acc, loss_acc, hg_acc, dx_buf)
 
-            def idle_branch(resid, grad_acc, loss_acc):
-                return zero_act, zero_grad, resid, grad_acc, loss_acc
+            def idle_branch(resid, grad_acc, loss_acc, hg_acc, dx_buf):
+                return (zero_act, zero_grad, resid, grad_acc, loss_acc,
+                        hg_acc, dx_buf)
 
             branch = jnp.where(is_f, 1, 0) + jnp.where(is_b, 2, 0)
-            send_f, send_b, resid, grad_acc, loss_acc = jax.lax.switch(
+            (send_f, send_b, resid, grad_acc, loss_acc, hg_acc,
+             dx_buf) = jax.lax.switch(
                 branch, [idle_branch, fwd_branch, bwd_branch],
-                resid, grad_acc, loss_acc)
+                resid, grad_acc, loss_acc, hg_acc, dx_buf)
             fwd_recv = jax.lax.ppermute(send_f, axis_name, fwd_perm)
             bwd_recv = jax.lax.ppermute(send_b, axis_name, bwd_perm)
-            return (fwd_recv, bwd_recv, resid, grad_acc, loss_acc), None
+            return (fwd_recv, bwd_recv, resid, grad_acc, loss_acc, hg_acc,
+                    dx_buf), None
 
         resid0 = match_vma(
             jnp.zeros((s, mb) + xb.shape[1:], xb.dtype), xb)
         grad0 = jax.tree.map(
             lambda a: match_vma(jnp.zeros(a.shape, jnp.float32), xb), params)
         loss0 = match_vma(jnp.float32(0.0), xb)
-        carry = (zero_act, zero_grad, resid0, grad0, loss0)
+        hg0 = jax.tree.map(
+            lambda a: match_vma(jnp.zeros(a.shape, jnp.float32), xb), hp)
+        dx0 = match_vma(
+            jnp.zeros(((m, mb) + xb.shape[1:]) if with_input_grad else (0,),
+                      jnp.float32), xb)
+        carry = (zero_act, zero_grad, resid0, grad0, loss0, hg0, dx0)
         carry, _ = jax.lax.scan(tick, carry, jnp.arange(2 * (m + s) - 2))
-        _, _, _, grad_acc, loss_acc = carry
-        loss = jax.lax.psum(loss_acc, axis_name) / m
-        grads = jax.tree.map(lambda a: (a / m)[None], grad_acc)
-        return loss, grads
+        _, _, _, grad_acc, loss_acc, hg_acc, dx_buf = carry
+        loss = _dp_mean(jax.lax.psum(loss_acc, axis_name) / m)
+        grads = _dp_mean(jax.tree.map(lambda a: (a / m)[None], grad_acc))
+        outs = [loss, grads]
+        if has_head:
+            outs.append(_dp_mean(jax.tree.map(
+                lambda a: jax.lax.psum(a, axis_name) / m, hg_acc)))
+        if with_input_grad:
+            # 1/dp matches the dp-averaged loss the other grads differentiate
+            dx = jax.lax.psum(dx_buf, axis_name) / (m * dp_size)
+            outs.append(dx.reshape((xb.shape[0],) + xb.shape[1:]))
+        return tuple(outs)
 
+    out_specs = (P(), P(axis_name))
+    if has_head:
+        out_specs = out_specs + (P(),)
+    if with_input_grad:
+        out_specs = out_specs + (batch_spec,)
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis_name), P(), P()),
-        out_specs=(P(), P(axis_name)),
+        in_specs=(P(axis_name), P(), batch_spec, batch_spec),
+        out_specs=out_specs,
         check_vma=False,
     )
-    return mapped(stage_params, x, tgts_in)
+    return mapped(stage_params, head_in, x, tgts_in)
 
 
 def stack_stages(param_trees: list) -> Any:
