@@ -24,6 +24,18 @@ from jax.sharding import PartitionSpec as P
 from tensorflowonspark_tpu.ops.attention import match_vma
 
 
+def _dp_batch_spec(mesh, data_axis: str, batch: int,
+                   n_microbatches: int) -> tuple[int, P]:
+    """Shared gpipe/1F1B data-parallel plumbing: the ``data_axis`` size, the
+    batch divisibility check, and the batch PartitionSpec."""
+    dp_size = dict(mesh.shape).get(data_axis, 1)
+    if batch % (dp_size * n_microbatches):
+        raise ValueError(
+            f"batch {batch} not divisible by {data_axis}-size x "
+            f"n_microbatches = {dp_size} x {n_microbatches}")
+    return dp_size, (P(data_axis) if dp_size > 1 else P())
+
+
 def _validate_stage_params(stage_params: Any, n_stages: int) -> None:
     """Shared gpipe/1F1B precondition: a stage-stacked params layout
     (every leaf leading dim == n_stages)."""
@@ -37,14 +49,19 @@ def _validate_stage_params(stage_params: Any, n_stages: int) -> None:
 
 
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
-          x: jax.Array, *, mesh, n_microbatches: int, axis_name: str = "pp"):
+          x: jax.Array, *, mesh, n_microbatches: int, axis_name: str = "pp",
+          data_axis: str = "dp"):
     """Run ``x`` through a pipeline of stages; returns the final activations.
 
     - ``stage_params``: pytree whose leaves have a leading ``n_stages`` dim
       (stage-stacked); sharded over ``pp`` by the wrapper.
     - ``stage_fn(params_i, mb) -> mb``: one stage's computation; activation
       shapes must be identical between stages (the inter-stage wire format).
-    - ``x``: global batch ``[B, …]`` with ``B % n_microbatches == 0``.
+    - ``x``: global batch ``[B, …]`` with ``B`` divisible by
+      ``data_axis``-size × ``n_microbatches``.  When the mesh's
+      ``data_axis`` (default ``dp``) has size > 1 the batch shards over it
+      and each dp row pipelines only its shard — without this, every row
+      would redundantly compute the full batch.
 
     **Bubble accounting.**  With ``m`` microbatches over ``s`` stages the
     schedule runs ``m + s - 1`` ticks of which each stage computes on ``m``,
@@ -58,9 +75,8 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
     """
     n_stages = mesh.shape[axis_name]
     _validate_stage_params(stage_params, n_stages)
-    if x.shape[0] % n_microbatches:
-        raise ValueError(f"batch {x.shape[0]} not divisible by "
-                         f"n_microbatches {n_microbatches}")
+    _, batch_spec = _dp_batch_spec(mesh, data_axis, x.shape[0],
+                                   n_microbatches)
 
     def body(params, xb):
         params = jax.tree.map(lambda a: a[0], params)   # local stage's slice
@@ -100,8 +116,8 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
 
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
+        in_specs=(P(axis_name), batch_spec),
+        out_specs=batch_spec,
         check_vma=False,
     )
     return mapped(stage_params, x)
@@ -157,12 +173,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     n_stages = mesh.shape[axis_name]
     m = n_microbatches
     _validate_stage_params(stage_params, n_stages)
-    dp_size = dict(mesh.shape).get(data_axis, 1)
-    if x.shape[0] % (dp_size * m):
-        raise ValueError(
-            f"batch {x.shape[0]} not divisible by {data_axis}-size x "
-            f"n_microbatches = {dp_size} x {m}")
-    batch_spec = P(data_axis) if dp_size > 1 else P()
+    dp_size, batch_spec = _dp_batch_spec(mesh, data_axis, x.shape[0], m)
     has_tgts = targets is not None
     tgts_in = targets if has_tgts else ()
     has_head = head_params is not None
