@@ -150,8 +150,10 @@ def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     ``jax.vjp`` (activation recompute, the standard 1F1B-with-remat
     trade: ~1.33x forward FLOPs).  The bubble fraction is GPipe's
     ``(s-1)/(m+s-1)``; 1F1B moves the backward earlier, it does not shrink
-    the bubble.  Beyond-reference capability — the reference has no
-    pipeline parallelism at all (SURVEY.md §2.3).
+    the bubble (an interleaved/virtual-stage schedule — v chunks per device,
+    bubble / v — is the known extension and is not implemented).
+    Beyond-reference capability — the reference has no pipeline parallelism
+    at all (SURVEY.md §2.3).
 
     Schedule (tick ``t``, stage ``i``, ``s`` stages, ``m`` microbatches):
     forward ``k`` runs at ``t = i + 2k``, backward ``k`` at
