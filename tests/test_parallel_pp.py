@@ -377,7 +377,12 @@ def test_1f1b_full_model_head_and_input_grads():
 
 
 @pytest.mark.slow
-def test_train_lm_pp_example_end_to_end():
+@pytest.mark.parametrize("extra", [
+    [],
+    ["--vocab-chunk", "128", "--bf16"],  # fused blockwise head: custom_vjp
+                                         # inside cond/switch/scan, bf16 wire
+], ids=["dense", "fused-bf16"])
+def test_train_lm_pp_example_end_to_end(extra):
     """examples/llm/train_lm.py --pp trains a real pipelined LM: the loss
     must descend (every param group — stages, head, embedding — is being
     updated through the 1F1B grads)."""
@@ -391,7 +396,7 @@ def test_train_lm_pp_example_end_to_end():
         [sys.executable, os.path.join(repo, "examples/llm/train_lm.py"),
          "--pp", "2", "--n-layers", "4", "--d-model", "64", "--n-heads", "4",
          "--seq-len", "128", "--batch", "16", "--steps", "5",
-         "--vocab-size", "256"],
+         "--vocab-size", "256"] + extra,
         capture_output=True, text=True, timeout=600, cwd=repo)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     losses = [float(v) for v in re.findall(r"loss=([0-9.]+)", proc.stdout)]
