@@ -16,10 +16,13 @@ BASELINE.json:5).
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Callable
 
 from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+logger = logging.getLogger(__name__)
 
 
 def _checkpointer():
@@ -86,7 +89,12 @@ def restore_checkpoint(path: str, target: Any | None = None) -> Any:
     # checkpoint written collectively by a 2-process jax.distributed mesh,
     # or a TPU checkpoint opened on CPU.  Callers re-place the tree on
     # their own mesh (dp.replicate / mesh.shard_tree) anyway.
-    meta = ckptr.metadata(local).item_metadata.tree
+    # orbax >= 0.9 wraps the saved tree's metadata (.item_metadata.tree);
+    # 0.7.x returns the metadata tree directly — accept both.
+    meta = ckptr.metadata(local)
+    item = getattr(meta, "item_metadata", None)
+    if item is not None:
+        meta = item.tree
     restore_args = jax.tree.map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta,
         is_leaf=lambda x: not isinstance(x, (dict, list, tuple)))
@@ -184,6 +192,34 @@ class CheckpointManager:
         excess = len(dirs) + pending - self.max_to_keep
         for _, path in dirs[: max(0, excess)]:
             shutil.rmtree(resolve_uri(path), ignore_errors=True)
+
+
+def restore_for_restart(ctx, manager: CheckpointManager,
+                        target: Any | None = None) -> tuple[Any, int] | None:
+    """Elastic-recovery resume: load the newest committed checkpoint before
+    (re-)entering the feed loop.
+
+    Call this at the top of a restartable map_fun.  On a first launch with an
+    empty model_dir it returns None (train from init); on a supervised
+    restart (``ctx.is_restart``) — or a rerun over a warm model_dir — it
+    returns ``(tree, step)`` from the latest ``step_N`` so the replacement
+    continues instead of repeating finished work.  The checkpoint-restart
+    contract of "TensorFlow: A system for large-scale machine learning"
+    (PAPERS.md); orbax's atomic commit guarantees the result is never a
+    torn mid-save state.
+    """
+    out = manager.restore_latest(target)
+    if out is None:
+        if ctx.is_restart:
+            logger.warning(
+                "node %d restarted (incarnation %d) but %s holds no committed "
+                "checkpoint; restarting the work from scratch",
+                ctx.executor_id, ctx.incarnation, manager.model_dir)
+        return None
+    _, step = out
+    logger.info("node %d (incarnation %d) resuming from step %d of %s",
+                ctx.executor_id, ctx.incarnation, step, manager.model_dir)
+    return out
 
 
 def chief_save(ctx, manager: CheckpointManager, step: int, tree: Any,

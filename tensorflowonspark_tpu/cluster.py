@@ -21,6 +21,7 @@ TPU-native deltas (BASELINE.json:5, SURVEY.md §2.3):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import enum
 import logging
@@ -33,8 +34,15 @@ from typing import Any, Callable, Sequence
 from tensorflowonspark_tpu.coordinator import CoordinatorServer
 from tensorflowonspark_tpu.data import as_partitioned
 from tensorflowonspark_tpu.dataserver import DataClient
-from tensorflowonspark_tpu.launcher import LocalLauncher, SubprocessLauncher  # noqa: F401 - LocalLauncher re-exported
+from tensorflowonspark_tpu.launcher import (  # noqa: F401 - LocalLauncher re-exported
+    LocalLauncher,
+    SubprocessLauncher,
+    TPUPodLauncher,
+)
 from tensorflowonspark_tpu.node import NodeConfig
+from tensorflowonspark_tpu.supervisor import RestartPolicy, Supervisor
+from tensorflowonspark_tpu.utils.envtune import env_float as _env_float
+from tensorflowonspark_tpu.utils.envtune import env_int as _env_int
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +72,184 @@ def _build_roles(num_executors: int, master_node: str | None, eval_node: bool) -
     return roles
 
 
+class _PartitionLedger:
+    """Driver-side record of every (epoch, partition) a ``train()`` call must
+    deliver: queued on its home slot, in flight on an executor, done, or
+    abandoned.
+
+    The reference got this bookkeeping from Spark's task scheduler — a dead
+    executor's partition-feed task was simply rerun elsewhere (PAPER.md
+    §5.3); with Spark gone the ledger reinstates it driver-side.  Placement
+    stays the reference's deterministic round-robin (partition ``i`` belongs
+    to feedable slot ``i % W``) while every slot is healthy; when a slot's
+    feed fails, its unacknowledged task moves to a shared *orphan* pool that
+    any worker — a surviving peer, or the slot's own supervised restart —
+    drains once its home queue is empty.  Training is therefore
+    at-least-once: a partition whose feed died mid-stream is re-fed from the
+    top, and the consumer may see some of its items twice.
+
+    An ack means ``feed_partition`` returned cleanly — the node BUFFERED the
+    whole partition + its EndPartition marker, not that the map_fun consumed
+    it.  A sudden death takes the queue's buffered tail down with it, so
+    acked tasks stay on a per-slot *delivered* list until the node's
+    consumption watermark (partitions whose EndPartition the map_fun popped,
+    reported with each ack) passes them; when recovery observes an actual
+    restart (fresh process, empty queues) the still-unconsumed window is
+    re-delivered via ``requeue_unconsumed`` — duplicates allowed, loss not.
+    The watermark baseline is conservative (first report after a (re)start
+    anchors it), which can only over-requeue, never under.
+    """
+
+    def __init__(self, num_partitions: int, num_epochs: int, num_slots: int,
+                 max_attempts: int = 3):
+        self._cond = threading.Condition()
+        self._own = [
+            collections.deque((e, p)
+                              for e in range(num_epochs)
+                              for p in range(pos, num_partitions, num_slots))
+            for pos in range(num_slots)
+        ]
+        self._orphans: collections.deque = collections.deque()
+        self._inflight: dict[int, tuple[int, int]] = {}
+        # whether the slot's in-flight task came from the orphan pool: a
+        # terminating consumer may forfeit its OWN share, but a dead peer's
+        # requeued work is not its to drop (abandon_slot)
+        self._inflight_orphan: dict[int, bool] = {}
+        self._attempts: dict[tuple[int, int], int] = {}
+        # buffered-on-the-node but not yet known-consumed, in feed order
+        self._delivered: list[collections.deque] = [
+            collections.deque() for _ in range(num_slots)]
+        self._watermark: list[int | None] = [None] * num_slots
+        self._outstanding = num_partitions * num_epochs
+        self._failure: Exception | None = None
+        self.max_attempts = max_attempts
+
+    def next_task(self, pos: int) -> tuple[int, int] | None:
+        """Block until slot ``pos`` has work (home queue first, then orphans)
+        or the feed is over; None means stop (all resolved, or failed)."""
+        with self._cond:
+            while True:
+                if self._failure is not None:
+                    return None
+                if self._own[pos]:
+                    task = self._own[pos].popleft()
+                    self._inflight_orphan[pos] = False
+                elif self._orphans:
+                    task = self._orphans.popleft()
+                    self._inflight_orphan[pos] = True
+                elif self._outstanding == 0:
+                    return None
+                else:
+                    # work may still be requeued by a failing peer
+                    self._cond.wait(0.5)
+                    continue
+                self._inflight[pos] = task
+                self._attempts[task] = self._attempts.get(task, 0) + 1
+                return task
+
+    def attempts(self, task: tuple[int, int]) -> int:
+        with self._cond:
+            return self._attempts.get(task, 0)
+
+    def ack(self, pos: int, consumed: int | None = None) -> None:
+        """The slot's in-flight partition was fully BUFFERED on the node;
+        ``consumed`` is the node's cumulative consumption watermark as of
+        this ack (None when the node predates the watermark protocol)."""
+        with self._cond:
+            task = self._inflight.pop(pos, None)
+            if task is not None:
+                self._delivered[pos].append(task)
+                self._outstanding -= 1
+                self._cond.notify_all()
+            self._advance_watermark_locked(pos, consumed)
+
+    def update_watermark(self, pos: int, consumed: int | None) -> None:
+        """Standalone watermark report (tail drain: the slot's feeds are all
+        acked, the driver polls the node for consumption progress)."""
+        with self._cond:
+            self._advance_watermark_locked(pos, consumed)
+
+    def _advance_watermark_locked(self, pos: int, consumed: int | None) -> None:
+        if consumed is None:
+            return
+        if self._watermark[pos] is None or consumed < self._watermark[pos]:
+            # first report since this (re)started process: anchor only —
+            # the count may include consumption the ledger never saw
+            # (an earlier train() on a reused cluster), so advancing on
+            # it could drop un-consumed work
+            self._watermark[pos] = consumed
+            return
+        delta = consumed - self._watermark[pos]
+        self._watermark[pos] = consumed
+        for _ in range(min(delta, len(self._delivered[pos]))):
+            self._delivered[pos].popleft()
+
+    def needs_drain(self, pos: int) -> bool:
+        """True while the slot has acked-but-not-known-consumed partitions —
+        work a sudden death would still take down with the node's queue."""
+        with self._cond:
+            return self._failure is None and bool(self._delivered[pos])
+
+    def failed(self) -> bool:
+        with self._cond:
+            return self._failure is not None
+
+    def requeue(self, pos: int) -> tuple[int, int] | None:
+        """Return the slot's unacknowledged task to the orphan pool (any
+        surviving or restarted worker may take it); returns that task."""
+        with self._cond:
+            task = self._inflight.pop(pos, None)
+            if task is not None:
+                self._orphans.append(task)
+                self._cond.notify_all()
+            return task
+
+    def requeue_unconsumed(self, pos: int) -> int:
+        """The slot's process RESTARTED (fresh empty queues): every
+        buffered-but-not-known-consumed task died with the predecessor's
+        queue — put them back in play.  Only correct after an actual
+        restart; on a mere socket loss the healthy node will still drain
+        its buffer and re-delivery would be pure duplication."""
+        with self._cond:
+            n = len(self._delivered[pos])
+            self._orphans.extend(self._delivered[pos])
+            self._delivered[pos].clear()
+            self._watermark[pos] = None  # replacement counts from zero
+            self._outstanding += n
+            if n:
+                self._cond.notify_all()
+            return n
+
+    def abandon_slot(self, pos: int) -> None:
+        """The slot's consumer said 'terminating': resolve its remaining home
+        tasks (and its in-flight one, if it was its own) as deliberately
+        dropped — reference semantics, an early-terminating node forfeits the
+        rest of its share.  An in-flight task acquired from the ORPHAN pool
+        is a dead peer's work, not this slot's to forfeit: it goes back for a
+        surviving or restarted worker to deliver.  Acked-but-unconsumed
+        partitions are forfeited either way: the consumer chose to stop with
+        them buffered."""
+        with self._cond:
+            dropped = len(self._own[pos])
+            self._own[pos].clear()
+            task = self._inflight.pop(pos, None)
+            if task is not None:
+                if self._inflight_orphan.get(pos):
+                    self._orphans.append(task)
+                else:
+                    dropped += 1
+            self._delivered[pos].clear()  # forfeited, not lost
+            self._outstanding -= dropped
+            self._cond.notify_all()
+
+    def fail(self, exc: Exception) -> None:
+        """Unrecoverable: wake every worker with a stop answer."""
+        with self._cond:
+            if self._failure is None:
+                self._failure = exc
+            self._cond.notify_all()
+
+
 class TPUCluster:
     """Handle to a running cluster (reference ``class TFCluster``)."""
 
@@ -77,6 +263,7 @@ class TPUCluster:
         queues: Sequence[str],
         feed_timeout: float,
         heartbeat_interval: float = 2.0,
+        elastic: bool | RestartPolicy = False,
     ):
         self.coordinator = coordinator
         self.launcher = launcher
@@ -88,6 +275,20 @@ class TPUCluster:
         self.feed_timeout = feed_timeout
         self.heartbeat_interval = heartbeat_interval
         self._clients: dict[int, DataClient] = {}
+        # incarnation each cached client was built against — the recovery
+        # baseline "which process was I talking to when the call failed"
+        # (reading the slot's CURRENT incarnation at failure time would miss
+        # a restart that completed while the failed call was still blocked)
+        self._client_incs: dict[int, int] = {}
+        # executor_id -> (ledger, slot) while a train() feed is live, so the
+        # dead-node monitor can re-deliver a dead slot's unconsumed window
+        self._active_ledger: dict[int, tuple] = {}
+        # Monotonic per-train() generation, prefixed onto every EndPartition
+        # dedupe key: node-side FeedQueues outlive a train() call on a reused
+        # cluster, and without the prefix a second train()'s (epoch,
+        # partition) keys would all hit the first train()'s seen-set, freeze
+        # the consumption watermark, and stall every slot's tail drain.
+        self._train_gen = 0
         self._shutdown_done = False
         # Feedable nodes: everything except the evaluator (the reference also
         # excluded ps nodes; we have none).
@@ -102,18 +303,35 @@ class TPUCluster:
         # feed_timeout.  Clean exits deregister first and are never flagged.
         self._dead_after = _env_float("TOS_DEAD_NODE_TIMEOUT",
                                       max(12.0, 6.0 * heartbeat_interval))
+        # Window for an in-flight death to be DECLARED (monitor poll +
+        # heartbeat silence) — _recover_client and _drain_slot_tail both key
+        # their "is this slot healthy / cleanly exited" judgements on the
+        # same window, and they must not drift apart.
+        self._declare_grace = self._dead_after + 3.0 * max(1.0, heartbeat_interval)
+        # Elastic recovery (supervisor.py): data-node deaths become supervised
+        # restarts instead of job failures; feed workers ride out the restart
+        # window (TOS_RECOVERY_TIMEOUT) and re-feed unacknowledged partitions.
+        self.supervisor: Supervisor | None = None
+        if elastic:
+            policy = elastic if isinstance(elastic, RestartPolicy) else None
+            self.supervisor = Supervisor(coordinator, launcher, policy)
+        self._recovery_timeout = _env_float("TOS_RECOVERY_TIMEOUT", 90.0)
+        self._max_feed_attempts = _env_int("TOS_MAX_PARTITION_ATTEMPTS", 3)
         self._monitor_stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="dead-node-monitor")
         self._monitor.start()
 
-    def _record_deaths(self) -> list[int]:
+    def _record_deaths(self, record_error: bool = True) -> list[int]:
         """Role-aware death bookkeeping, shared by the monitor thread and
         shutdown's death-aware join.  The evaluator is an optional SIDECAR —
         no feed, no collectives — so its death is logged and forgotten
         (training continues; reference parity: a failed auxiliary executor
-        didn't fail the job).  Data-node deaths are recorded as node errors
-        (idempotently) and returned for the caller to escalate on."""
+        didn't fail the job).  Data-node deaths are declared (incarnation
+        fenced, in-flight rendezvous aborted) and the newly-declared ids are
+        returned for the caller to escalate on; ``record_error=False`` is the
+        elastic path — a death the supervisor will recover from must not
+        leave a fatal node error behind."""
         dead = self.coordinator.dead_nodes(self._dead_after)
         dead_eval = [i for i in dead if i not in self._feed_ids]
         if dead_eval:
@@ -122,12 +340,46 @@ class TPUCluster:
             self.coordinator.forget(dead_eval)
         dead_data = [i for i in dead if i in self._feed_ids]
         if dead_data:
-            self.coordinator.mark_dead(dead_data)
+            return self.coordinator.mark_dead(dead_data,
+                                              record_error=record_error)
         return dead_data
 
     def _monitor_loop(self) -> None:
         poll = max(1.0, self.heartbeat_interval)
         while not self._monitor_stop.wait(poll):
+            if self.supervisor is not None:
+                # Elastic path: the death is declared WITHOUT a fatal node
+                # error and handed to the supervisor; monitoring continues —
+                # further deaths (including the replacement's) re-enter here.
+                for eid in self._record_deaths(record_error=False):
+                    logger.warning("node %d stopped heartbeating (>%.0fs); "
+                                   "scheduling supervised restart",
+                                   eid, self._dead_after)
+                    # dead process = dead queue: its in-flight partition AND
+                    # its buffered-but-unconsumed window go back in play
+                    # BEFORE the restart begins.  The in-flight requeue
+                    # matters on a blackholed host: the slot's feed worker is
+                    # still wedged inside feed_partition riding out
+                    # call_timeout, and without it the task would stay pinned
+                    # (and every surviving worker spin-waiting on it) for the
+                    # full ~11-minute socket budget; the worker's own later
+                    # requeue is then a safe no-op.
+                    entry = self._active_ledger.get(eid)
+                    if entry is not None:
+                        entry[0].requeue(entry[1])
+                        n = entry[0].requeue_unconsumed(entry[1])
+                        if n:
+                            logger.warning("re-delivering %d buffered "
+                                           "partition(s) node %d died holding",
+                                           n, eid)
+                    # Tear the dead slot's cached data client down NOW: a
+                    # feed worker blocked inside it (a dead ring peer sends
+                    # no RST) would otherwise ride out the full call_timeout
+                    # (~11 min) before noticing, and the worker's own
+                    # _drop_client on that error path is a safe no-op.
+                    self._drop_client(eid, abort=True)
+                    self.supervisor.handle_death(eid)
+                continue
             dead_data = self._record_deaths()
             if dead_data:
                 logger.error("nodes %s stopped heartbeating (>%.0fs); failing "
@@ -142,14 +394,168 @@ class TPUCluster:
 
     # -- data-plane connections ---------------------------------------------
 
-    def _client(self, executor_id: int) -> DataClient:
-        if executor_id not in self._clients:
-            meta = self.cluster_info[executor_id]
-            self._clients[executor_id] = DataClient(
+    def _fresh_meta(self, executor_id: int) -> dict:
+        """Current node meta from the coordinator, not the formation-time
+        snapshot: a supervised restart re-registered this slot with a NEW
+        host/data_port, and the snapshot would dial the dead one."""
+        return (self.coordinator.node_meta(executor_id)
+                or self.cluster_info[executor_id])
+
+    def _client(self, executor_id: int, *, connect_timeout: float = 60.0,
+                connect_attempts: int | None = None) -> DataClient:
+        # Return the looked-up/constructed instance, never a second dict
+        # read: the monitor's _drop_client(abort=True) may pop the entry
+        # concurrently with a death declaration, and a re-lookup here would
+        # KeyError — the caller still holds a usable (if doomed) client whose
+        # next call surfaces the real data-plane failure instead.
+        client = self._clients.get(executor_id)
+        if client is None:
+            meta = self._fresh_meta(executor_id)
+            inc, _ = self.coordinator.registered_incarnation(executor_id)
+            # Record the targeted incarnation BEFORE dialing: even a failed
+            # dial establishes the recovery baseline "which process was I
+            # trying to reach", which _recover_client compares restarts
+            # against.
+            self._client_incs[executor_id] = inc
+            client = DataClient(
                 meta["host"], meta["data_port"], self.authkey,
                 call_timeout=self.feed_timeout + 60.0,
-                stall_timeout=self.feed_timeout)
-        return self._clients[executor_id]
+                stall_timeout=self.feed_timeout,
+                connect_timeout=connect_timeout,
+                connect_attempts=connect_attempts)
+            self._clients[executor_id] = client
+        return client
+
+    def _drop_client(self, executor_id: int, *, abort: bool = False) -> None:
+        """Discard (and best-effort close) the slot's cached data client —
+        its socket/ring died with the failure that led here.  ``abort=True``
+        (the monitor's death declaration) tears the socket down WITHOUT the
+        per-client lock, so a feed worker wedged mid-call on the dead peer is
+        woken instead of waited on."""
+        stale = self._clients.pop(executor_id, None)
+        if stale is not None:
+            with contextlib.suppress(Exception):
+                stale.abort() if abort else stale.close()
+
+    def _recover_client(self, executor_id: int, *,
+                        require_restart: bool = False,
+                        cancel: Callable[[], bool] | None = None) -> DataClient | None:
+        """After a data-plane failure on ``executor_id``: wait out the slot's
+        restart window and hand back a fresh client, or None when the slot
+        cannot (or must not) be re-fed.  ``cancel`` lets the caller's job
+        abort this wait early (a peer already failed the whole feed — pinning
+        its join on this slot's 90s window would only delay that error).
+
+        ``require_restart=True`` is the inference rule: only a *restarted*
+        node (fresh process, empty queues — observable as a bumped
+        incarnation) may be re-fed, because a healthy node whose socket
+        merely severed can still hold partial results of the failed attempt
+        in its output queue, and a re-feed would corrupt the exactly-count
+        invariant.  Training re-feeds either way (at-least-once).
+        """
+        # Baseline = the incarnation the FAILED client was talking to (kept
+        # by _client/_drop_client), not the slot's current one: a restart
+        # that completed while the failed call was still blocked (e.g. a
+        # zombie riding out stall_timeout) already bumped the current value.
+        inc0 = self._client_incs.get(
+            executor_id, self.coordinator.registered_incarnation(executor_id)[0])
+        deadline = time.monotonic() + self._recovery_timeout
+        grace_end = time.monotonic() + self._declare_grace
+        while time.monotonic() < deadline and not self._shutdown_done:
+            if cancel is not None and cancel():
+                return None
+            if (self.supervisor is not None
+                    and self.supervisor.permanently_failed(executor_id) is not None):
+                return None
+            inc, tracked = self.coordinator.registered_incarnation(executor_id)
+            restarted = inc > inc0
+            if tracked and (restarted or not require_restart):
+                try:
+                    # Short bounded dial: the outer loop is the retry.  The
+                    # default 60s x 3-attempt dial would let one blackholed
+                    # host pin this thread minutes past _recovery_timeout.
+                    return self._client(executor_id, connect_timeout=5.0,
+                                        connect_attempts=1)
+                except Exception:  # noqa: BLE001 - port dark mid-restart
+                    time.sleep(0.5)
+                    continue
+            if not tracked:
+                if self.supervisor is None:
+                    return None  # declared dead with nobody to revive it
+                if (not self.supervisor.restarting(executor_id)
+                        and any(e.get("executor_id") == executor_id
+                                for e in self.coordinator.errors())):
+                    # The node EXITED with a recorded error (map_fun failure:
+                    # report_error + deregister, never declared dead) — no
+                    # restart was or will be scheduled, so waiting out the
+                    # recovery window would just delay the inevitable by 90s.
+                    return None
+            if require_restart and tracked and not restarted \
+                    and time.monotonic() > grace_end:
+                return None  # healthy-node sever: re-feeding is not safe
+            time.sleep(0.5)
+        return None
+
+    def _drain_slot_tail(self, ledger, worker_pos: int, executor_id: int,
+                         qname: str, client: DataClient | None) -> DataClient | None:
+        """Elastic train tail: poll the slot's consumption watermark until its
+        acked-but-unconsumed window empties, the node dies (the monitor then
+        requeues the window, clearing it here), or consumption stalls.
+
+        The stall bound (``TOS_DRAIN_STALL_TIMEOUT``) keeps a map_fun that
+        deliberately stopped consuming (a ``max_steps`` cutoff) from pinning
+        ``train()`` forever — on stall the pre-drain semantics return: the
+        buffered tail is the consumer's to lose.  Returns the (possibly
+        refreshed or dropped) data client for the caller to keep using."""
+        stall_limit = _env_float("TOS_DRAIN_STALL_TIMEOUT", 300.0)
+        # Grace for the monitor to turn an observed "untracked" into either a
+        # supervised restart or a window requeue before we call it a CLEAN
+        # exit (deregister) — same window _recover_client uses.
+        untracked_grace = self._declare_grace
+        last_wm: int | None = None
+        last_progress = time.monotonic()
+        untracked_since: float | None = None
+        while ledger.needs_drain(worker_pos):
+            if (self._shutdown_done
+                    or self.supervisor.permanently_failed(executor_id) is not None):
+                return client
+            # Checked EVERY iteration (the poll below may fail forever
+            # against an exited process): a slot that stays untracked with
+            # no restart in flight past the grace deregistered CLEANLY —
+            # its consumer chose to exit with the tail buffered, which
+            # forfeits it exactly like a 'terminating' answer would.
+            _, tracked = self.coordinator.registered_incarnation(executor_id)
+            if tracked or self.supervisor.restarting(executor_id):
+                untracked_since = None
+            elif untracked_since is None:
+                untracked_since = time.monotonic()
+            elif time.monotonic() - untracked_since > untracked_grace:
+                logger.warning(
+                    "executor %d exited cleanly with buffered partitions "
+                    "unconsumed; its tail is forfeited", executor_id)
+                return client
+            if time.monotonic() - last_progress > stall_limit:
+                logger.warning(
+                    "executor %d stopped consuming with buffered partitions "
+                    "outstanding (no progress in %.0fs); leaving its tail "
+                    "un-drained", executor_id, stall_limit)
+                return client
+            try:
+                if client is None:
+                    client = self._client(executor_id, connect_timeout=5.0,
+                                          connect_attempts=1)
+                wm = client.poll_consumed(qname)
+            except Exception:  # noqa: BLE001 - slot mid-death/restart
+                self._drop_client(executor_id)
+                client = None
+                time.sleep(0.5)
+                continue
+            ledger.update_watermark(worker_pos, wm)
+            if wm != last_wm:
+                last_wm = wm
+                last_progress = time.monotonic()
+            time.sleep(0.2)
+        return client
 
     # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------
 
@@ -168,30 +574,123 @@ class TPUCluster:
         if self.input_mode != InputMode.STREAMING:
             raise RuntimeError("train(data) requires InputMode.STREAMING (reference: InputMode.SPARK)")
         dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+        # One view per epoch (identity, or the seeded between-epochs shuffle);
+        # precomputed so a re-fed partition sees the same epoch ordering.
+        views = [dataset if shuffle_seed is None
+                 else dataset.shuffle_partitions(shuffle_seed + epoch)
+                 for epoch in range(num_epochs)]
+        ledger = _PartitionLedger(dataset.num_partitions, num_epochs,
+                                  len(self._feed_ids),
+                                  max_attempts=self._max_feed_attempts)
+        self._train_gen += 1
+        train_gen = self._train_gen
         errors: list[Exception] = []
 
         def _feed_worker(worker_pos: int, executor_id: int) -> None:
+            client: DataClient | None = None
+            while True:
+                task = ledger.next_task(worker_pos)
+                if task is None:
+                    # All partitions resolved — but "acked" only means
+                    # buffered on the node.  In elastic mode nobody may walk
+                    # away while this slot still holds unconsumed work: a
+                    # death seconds after train() returns would be recovered
+                    # (no error recorded) with the buffered tail silently
+                    # gone.  Poll the node's watermark until the window
+                    # drains; if the node dies instead, the monitor requeues
+                    # the window and next_task hands it back out here.
+                    if self.supervisor is None or not ledger.needs_drain(worker_pos):
+                        return
+                    client = self._drain_slot_tail(ledger, worker_pos,
+                                                   executor_id, qname, client)
+                    if not ledger.needs_drain(worker_pos):
+                        continue  # drained, or death requeued the window
+                    return  # shutdown / permanent failure / consumption stall
+                # THIS holder's attempt number, captured at acquisition: after
+                # a requeue the task is shared state again, and a peer popping
+                # it would bump the live counter — judging the budget off a
+                # re-read could fail the job while that peer's viable attempt
+                # is still in flight.
+                attempt = ledger.attempts(task)
+                epoch, p = task
+                try:
+                    if client is None:
+                        client = self._client(executor_id)
+                    # (train_gen, epoch, partition) is the EndPartition
+                    # dedupe key: a re-feed of this same task must not
+                    # double-count in the node's consumption watermark, while
+                    # a LATER train() on a reused cluster (new generation)
+                    # must count afresh
+                    state = client.feed_partition(
+                        views[epoch].iter_partition(p), qname,
+                        task_key=(train_gen,) + task)
+                except Exception as e:  # noqa: BLE001 - wrapped + ledgered below
+                    wrapped = RuntimeError(
+                        f"feeding executor {executor_id} failed on partition "
+                        f"{p} (epoch {epoch}, attempt {attempt}"
+                        f"/{ledger.max_attempts}): {e}")
+                    wrapped.__cause__ = e
+                    # Unacked partition back to the pool (at-least-once), then
+                    # ride out the slot's restart window; a surviving peer may
+                    # pick the orphan up meanwhile.
+                    ledger.requeue(worker_pos)
+                    inc_failed = self._client_incs.get(executor_id)
+                    self._drop_client(executor_id)
+                    client = None
+                    if attempt >= ledger.max_attempts:
+                        errors.append(wrapped)
+                        ledger.fail(wrapped)
+                        return
+                    logger.warning("%s; awaiting recovery", wrapped)
+                    client = self._recover_client(executor_id,
+                                                  cancel=ledger.failed)
+                    if client is None:
+                        errors.append(wrapped)
+                        ledger.fail(wrapped)
+                        return
+                    if self._client_incs.get(executor_id) != inc_failed:
+                        # actual restart: the predecessor's queue (and every
+                        # buffered-but-unconsumed partition in it) is gone
+                        n = ledger.requeue_unconsumed(worker_pos)
+                        if n:
+                            logger.warning(
+                                "executor %d restarted with %d buffered "
+                                "partition(s) unconsumed; re-delivering them",
+                                executor_id, n)
+                    continue
+                if state == "terminating":
+                    logger.info("node %d terminating; dropping remaining feed", executor_id)
+                    ledger.abandon_slot(worker_pos)
+                    return
+                ledger.ack(worker_pos, client.partitions_consumed(qname))
+
+        def _runner(worker_pos: int, executor_id: int) -> None:
             try:
-                client = self._client(executor_id)
-                for epoch in range(num_epochs):
-                    epoch_data = (dataset if shuffle_seed is None
-                                  else dataset.shuffle_partitions(shuffle_seed + epoch))
-                    for p in range(worker_pos, dataset.num_partitions, len(self._feed_ids)):
-                        state = client.feed_partition(epoch_data.iter_partition(p), qname)
-                        if state == "terminating":
-                            logger.info("node %d terminating; dropping remaining feed", executor_id)
-                            return
-            except Exception as e:
-                errors.append(e)
+                _feed_worker(worker_pos, executor_id)
+            except Exception as e:  # noqa: BLE001 - never strand the ledger
+                wrapped = RuntimeError(
+                    f"feed worker for executor {executor_id} crashed: {e}")
+                wrapped.__cause__ = e
+                errors.append(wrapped)
+                ledger.fail(wrapped)
 
         threads = [
-            threading.Thread(target=_feed_worker, args=(pos, eid), name=f"feed-{eid}")
+            threading.Thread(target=_runner, args=(pos, eid), name=f"feed-{eid}")
             for pos, eid in enumerate(self._feed_ids)
         ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        # The monitor re-delivers a dead slot's buffered-but-unconsumed
+        # window the moment it declares the death — the slot's own feed
+        # worker may be idle in next_task() at that point and would never
+        # pass through the recovery path that also checks.
+        self._active_ledger = {eid: (ledger, pos)
+                               for pos, eid in enumerate(self._feed_ids)}
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            self._active_ledger = {}
         self._raise_node_errors()
         if errors:
             raise RuntimeError(f"feeding failed: {errors[0]}") from errors[0]
@@ -263,20 +762,64 @@ class TPUCluster:
         errors: list[Exception] = []
 
         def _infer_worker(worker_pos: int, executor_id: int) -> None:
+            # The worker's share of partitions, retried in place on failure.
+            # Exactly-once is preserved by construction: the consumer reads a
+            # partition's results from ``buf[p]`` exactly once, and a failed
+            # attempt is only ever retried against a *restarted* node (fresh
+            # queues) — never a healthy one that may hold partial results
+            # (``_recover_client(require_restart=True)``).
+            pending = collections.deque(
+                range(worker_pos, dataset.num_partitions, num_workers))
+            client: DataClient | None = None
+            attempts = 0
             try:
-                client = self._client(executor_id)
-                for p in range(worker_pos, dataset.num_partitions, num_workers):
+                while pending:
+                    p = pending[0]
                     with cond:
                         cond.wait_for(lambda: p < state["next"] + window
                                       or state["stopped"])
                         if state["stopped"]:
                             return
-                    part = client.infer_partition(dataset.iter_partition(p),
-                                                  qname_in, qname_out)
+                    try:
+                        if client is None:
+                            client = self._client(executor_id)
+                        part = client.infer_partition(dataset.iter_partition(p),
+                                                      qname_in, qname_out)
+                    except Exception as e:  # noqa: BLE001 - wrapped below
+                        # A failed DIAL (client is still None) sent nothing:
+                        # no partial results can exist anywhere, so any live
+                        # process is safe to feed — demanding a restart would
+                        # wedge recovery when the slot died pre-dial (the
+                        # incarnation baseline already includes the death
+                        # bump, so "restarted" could never be observed).
+                        had_conn = client is not None
+                        attempts += 1
+                        wrapped = RuntimeError(
+                            f"inference executor {executor_id} failed on "
+                            f"partition {p} (attempt {attempts}"
+                            f"/{self._max_feed_attempts}): {e}")
+                        wrapped.__cause__ = e
+                        self._drop_client(executor_id)
+                        client = None
+                        if attempts < self._max_feed_attempts:
+                            logger.warning("%s; awaiting recovery", wrapped)
+                            client = self._recover_client(
+                                executor_id, require_restart=had_conn,
+                                cancel=lambda: state["stopped"] or bool(errors))
+                        if client is None:
+                            with cond:
+                                errors.append(wrapped)
+                                cond.notify_all()
+                            return
+                        continue
+                    attempts = 0
+                    pending.popleft()
                     with cond:
                         buf[p] = part
                         cond.notify_all()
                 if eof_when_done:
+                    if client is None:
+                        client = self._client(executor_id)
                     client.send_eof(qname_in)
             except Exception as e:
                 with cond:
@@ -326,14 +869,25 @@ class TPUCluster:
 
     # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
 
-    def shutdown(self, grace_secs: float = 0.0, timeout: float = 120.0) -> None:
-        """Send end-of-feed, join node processes, propagate node errors."""
+    def shutdown(self, grace_secs: float = 0.0, timeout: float | None = None) -> None:
+        """Send end-of-feed, join node processes, propagate node errors.
+
+        ``timeout`` defaults to 120s, env-overridable via
+        ``TOS_SHUTDOWN_TIMEOUT`` (and EOF delivery honours
+        ``TOS_EOF_TIMEOUT``) — the ``TFOS_SERVER_TIMEOUT``-style ops knobs.
+        """
+        if timeout is None:
+            timeout = _env_float("TOS_SHUTDOWN_TIMEOUT", 120.0)
         if self._shutdown_done:
             return
         # Stop the dead-node monitor first: shutdown's own escalation
         # (join -> stop -> terminate) owns failure handling from here, and
-        # nodes it terminates must not be re-reported as deaths.
+        # nodes it terminates must not be re-reported as deaths.  The
+        # supervisor stops with it — a node dying during teardown is a
+        # failure to report, not a slot to refill.
         self._monitor_stop.set()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         try:
             # DIRECT-mode map_funs never consume the feed; EOF would just open
             # pointless connections to nodes that may already have exited.
@@ -358,7 +912,13 @@ class TPUCluster:
                         continue
                     for qname in self.input_qnames:
                         try:
-                            self._client(executor_id).send_eof(qname)
+                            # Teardown dial: one short attempt (the capped
+                            # retry below handles the rest) — the default
+                            # 3x60s backoff dial would stack ~185s per queue
+                            # against a blackholed host, all outside the
+                            # shutdown timeout budget.
+                            self._client(executor_id, connect_timeout=5.0,
+                                         connect_attempts=1).send_eof(qname)
                         except Exception:
                             proc = id_to_proc.get(executor_id)
                             if proc is not None and not proc.is_alive():
@@ -379,11 +939,17 @@ class TPUCluster:
                                 with contextlib.suppress(Exception):
                                     stale.close()
                             try:
-                                meta = self.cluster_info[executor_id]
+                                meta = self._fresh_meta(executor_id)
+                                # One short dial only: teardown against an
+                                # unreachable host must not stack the default
+                                # 3-attempt backoff (~3x60s) outside the
+                                # shutdown timeout budget.
                                 retry = DataClient(meta["host"], meta["data_port"],
                                                    self.authkey, prefer_ring=False,
                                                    call_timeout=30.0,
-                                                   stall_timeout=30.0)
+                                                   stall_timeout=30.0,
+                                                   connect_timeout=5.0,
+                                                   connect_attempts=1)
                                 try:
                                     retry.send_eof(qname)
                                 finally:
@@ -483,26 +1049,6 @@ class TPUCluster:
         return None
 
 
-def _env_float(name: str, default: float) -> float:
-    """Env-tunable default (reference: ``TFOS_SERVER_TIMEOUT``-style knobs,
-    ``reservation.py:~120-160``): ops can raise cluster-formation / feed
-    budgets fleet-wide without touching job code."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        value = float(raw)
-    except ValueError:
-        logger.warning("ignoring non-numeric %s=%r", name, raw)
-        return default
-    if value <= 0:
-        # 0 is NOT "no timeout" here: it would make every data-plane put
-        # fail instantly; fail safe to the default instead
-        logger.warning("ignoring non-positive %s=%r", name, raw)
-        return default
-    return value
-
-
 def run(
     map_fun: Callable,
     tf_args: Any = None,
@@ -523,6 +1069,7 @@ def run(
     per_node_env: Sequence[dict[str, str]] | None = None,
     jax_distributed: bool = False,
     coordinator_host: str | None = None,
+    elastic: bool | RestartPolicy = False,
 ) -> TPUCluster:
     """Start a cluster (reference ``TFCluster.run`` ``:~270-420``).
 
@@ -540,6 +1087,17 @@ def run(
     (the reference's ``TFOS_SERVER_TIMEOUT``-style ops knobs), else
     120s/600s.
 
+    ``elastic`` turns data-node deaths into supervised restarts (True for the
+    env-tuned ``RestartPolicy``, or pass a policy): the slot's incarnation is
+    fenced, the process is respawned with backoff, the replacement resumes
+    from the latest checkpoint (``ctx.is_restart`` /
+    ``checkpoint.restore_for_restart``), and unacknowledged partitions are
+    re-fed (at-least-once for training; exactly-once per partition for
+    inference).  Feed-driven map_funs only: a ``jax.distributed`` job cannot
+    readmit a process into a live XLA world, so the combination is refused,
+    and map_funs built on control-plane consensus (``ctx.all_done``) need
+    application-level resync a restart does not provide.
+
     ``coordinator_host`` pins the control-plane bind/advertise interface
     (default: bind all interfaces, advertise the routable ``local_ip()`` so
     remote executors launched over ssh can actually dial back — reference
@@ -547,6 +1105,16 @@ def run(
     connection with the per-cluster ``authkey`` (HMAC challenge-response,
     same handshake as the data plane).
     """
+    # TPUPodLauncher forces jax_distributed=True on every NodeConfig it
+    # launches, so checking the parameter alone would let a pod job slip
+    # past the guard.
+    if elastic and (jax_distributed or isinstance(launcher, TPUPodLauncher)):
+        raise ValueError(
+            "elastic=... cannot be combined with a jax.distributed job "
+            "(jax_distributed=True or a TPUPodLauncher): a restarted "
+            "process cannot rejoin a live jax.distributed XLA world "
+            "(TF-Replicator generation semantics); run elastic jobs as "
+            "per-host meshes")
     if reservation_timeout is None:
         reservation_timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
     if feed_timeout is None:
@@ -595,4 +1163,4 @@ def run(
         raise
     logger.info("cluster up: %s", [(m["executor_id"], m["job_name"]) for m in cluster_info])
     return TPUCluster(coordinator, launcher, cluster_info, authkey, input_mode,
-                      queues, feed_timeout, heartbeat_interval)
+                      queues, feed_timeout, heartbeat_interval, elastic=elastic)

@@ -116,6 +116,13 @@ class CoordinatorServer:
         self._errors: list[dict] = []
         self._rdv: dict[str, _Rendezvous] = {}
         self._last_seen: dict[int, float] = {}
+        # Generation fencing (TF-Replicator-style, PAPERS.md): each executor
+        # slot has an incarnation number, bumped the moment the slot is
+        # declared dead.  Every node-side message carries its incarnation;
+        # anything from a stale incarnation — a zombie that lost its network,
+        # not its life — is rejected, so a restarted replacement can never
+        # race its predecessor on heartbeats, barriers, or reduces.
+        self._incarnations: dict[int, int] = {}
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.address: tuple[str, int] | None = None
@@ -210,6 +217,15 @@ class CoordinatorServer:
         with self._lock:
             return [dict(m) for m in sorted(self._nodes, key=lambda m: m["executor_id"])]
 
+    def node_meta(self, executor_id: int) -> dict | None:
+        """Current meta of one slot (a replacement rewrites it wholesale) —
+        the single lookup the supervisor and the driver's data-plane recovery
+        both use, so they can never disagree on a slot's host/port."""
+        with self._lock:
+            meta = next((m for m in self._nodes
+                         if m["executor_id"] == executor_id), None)
+            return dict(meta) if meta is not None else None
+
     def errors(self) -> list[dict]:
         with self._lock:
             return list(self._errors)
@@ -227,22 +243,64 @@ class CoordinatorServer:
             for i in executor_ids:
                 self._last_seen.pop(i, None)
 
-    def mark_dead(self, executor_ids: list[int]) -> None:
-        """Record heartbeat-silent nodes as node errors (driver monitor path)
-        and stop tracking them.  Idempotent: the error is appended only when
-        the node was still being tracked, so the monitor thread and
-        shutdown's death-aware join racing on the same death report it
-        exactly once."""
+    def mark_dead(self, executor_ids: list[int],
+                  record_error: bool = True) -> list[int]:
+        """Declare heartbeat-silent nodes dead: stop tracking them, FENCE
+        their incarnation (everything the old process sends from now on is
+        rejected), and abort any in-flight barrier/reduce generation — the
+        dead peer will never arrive, so waiters would only ride out their
+        full timeout.  Idempotent: only nodes still being tracked are
+        processed, so the monitor thread and shutdown's death-aware join
+        racing on the same death act exactly once; the newly-declared ids
+        are returned for the caller to escalate (or hand to the supervisor).
+
+        ``record_error=False`` is the elastic path: a death the supervisor
+        will recover from must not leave a fatal node error behind."""
+        newly: list[int] = []
         with self._lock:
             for i in executor_ids:
                 if self._last_seen.pop(i, None) is None:
                     continue
-                self._errors.append({
-                    "executor_id": i,
-                    "traceback": (f"node {i} stopped heartbeating (process died "
-                                  "or host unreachable); detected by driver "
-                                  "monitor (SURVEY.md §5.3)"),
-                })
+                newly.append(i)
+                self._incarnations[i] = self._incarnations.get(i, 0) + 1
+                if record_error:
+                    self._errors.append({
+                        "executor_id": i,
+                        "traceback": (f"node {i} stopped heartbeating (process died "
+                                      "or host unreachable); detected by driver "
+                                      "monitor (SURVEY.md §5.3)"),
+                    })
+        if newly:
+            self._abort_rendezvous()
+        return newly
+
+    def record_failure(self, executor_id: int, reason: str) -> None:
+        """Driver-side synthesized node error (e.g. supervised restart budget
+        exhausted) — surfaces through the same channel map_fun errors use."""
+        with self._lock:
+            self._errors.append({"executor_id": executor_id, "traceback": reason})
+
+    def is_tracked(self, executor_id: int) -> bool:
+        """Whether the executor is currently liveness-tracked (alive)."""
+        with self._lock:
+            return executor_id in self._last_seen
+
+    def registered_incarnation(self, executor_id: int) -> tuple[int, bool]:
+        """(current incarnation, is currently liveness-tracked)."""
+        with self._lock:
+            return (self._incarnations.get(executor_id, 0),
+                    executor_id in self._last_seen)
+
+    def _abort_rendezvous(self) -> None:
+        """Abort every in-flight barrier/reduce generation (peer death)."""
+        with self._lock:
+            rdvs = list(self._rdv.values())
+            self._rdv.clear()
+        for rdv in rdvs:
+            with rdv.cond:
+                if not rdv.done:
+                    rdv.aborted = True
+                    rdv.cond.notify_all()
 
     def signal_stop(self) -> None:
         """Make subsequent heartbeats tell nodes to stop (zombie-free teardown)."""
@@ -250,9 +308,37 @@ class CoordinatorServer:
 
     # -- request dispatch ----------------------------------------------------
 
+    def _is_fenced(self, msg: dict) -> bool:
+        """True when the message comes from a stale incarnation of a slot
+        that was declared dead (the sender is a zombie predecessor of a
+        restarted node).  Messages that carry no incarnation pass — only a
+        peer that knows the fencing protocol can be fenced by it, and a
+        slot that never died has incarnation 0 which every fresh client
+        stamps anyway."""
+        eid, inc = msg.get("executor_id"), msg.get("incarnation")
+        if eid is None or inc is None:
+            return False
+        with self._lock:
+            return int(inc) < self._incarnations.get(int(eid), 0)
+
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op")
         try:
+            if op != "register" and self._is_fenced(msg):
+                # TF-Replicator-style generation fencing: the zombie must
+                # never influence live state.  Heartbeats answer stop=True so
+                # the stale process deliberately winds itself down; barriers/
+                # reduces fail loudly (joining a live generation would wedge
+                # or corrupt it); reports (error/deregister/update_meta) are
+                # swallowed — the supervisor already owns this slot's fate.
+                if op == "heartbeat":
+                    return {"ok": True, "stop": True, "fenced": True}
+                if op in ("barrier", "reduce"):
+                    return {"ok": False, "fenced": True,
+                            "error": (f"stale incarnation {msg.get('incarnation')} for "
+                                      f"executor {msg.get('executor_id')}: slot was "
+                                      "declared dead and re-fenced")}
+                return {"ok": True, "fenced": True}
             if op == "register":
                 return self._op_register(msg)
             if op == "query":
@@ -303,6 +389,9 @@ class CoordinatorServer:
 
     def _op_register(self, msg: dict) -> dict:
         meta = dict(msg.get("meta") or {})
+        replace = msg.get("replace")
+        if replace is not None:
+            return self._op_register_replacement(int(replace), meta)
         with self._lock:
             if self._complete.is_set():
                 return {"ok": False, "error": "cluster already complete"}
@@ -311,11 +400,42 @@ class CoordinatorServer:
             meta.update(executor_id=executor_id, job_name=job_name, task_index=task_index)
             self._nodes.append(meta)
             self._last_seen[executor_id] = time.monotonic()
+            incarnation = self._incarnations.get(executor_id, 0)
             if len(self._nodes) == self.expected:
                 self._complete.set()
         logger.info("registered node %d as %s:%d (%s)", executor_id, job_name, task_index, meta.get("host"))
         return {"ok": True, "executor_id": executor_id, "job_name": job_name,
-                "task_index": task_index, "expected": self.expected}
+                "task_index": task_index, "expected": self.expected,
+                "incarnation": incarnation}
+
+    def _op_register_replacement(self, executor_id: int, meta: dict) -> dict:
+        """Re-register a supervised restart into its predecessor's slot.
+
+        The slot keeps its executor_id/role (SPMD layout is positional), the
+        meta (host/data_port/pid) is replaced wholesale, and the node adopts
+        the slot's CURRENT incarnation — already bumped past the dead
+        predecessor by ``mark_dead``, so the zombie stays fenced while the
+        replacement is fully live."""
+        with self._lock:
+            if not self._complete.is_set():
+                return {"ok": False, "error": "cannot replace before the cluster formed"}
+            slot = next((m for m in self._nodes if m["executor_id"] == executor_id), None)
+            if slot is None:
+                return {"ok": False, "error": f"no executor slot {executor_id} to replace"}
+            if executor_id in self._last_seen:
+                return {"ok": False, "error": (f"executor {executor_id} is still "
+                                               "liveness-tracked; refusing replacement")}
+            job_name, task_index = self.roles[executor_id]
+            meta.update(executor_id=executor_id, job_name=job_name, task_index=task_index)
+            slot.clear()
+            slot.update(meta)
+            self._last_seen[executor_id] = time.monotonic()
+            incarnation = self._incarnations.get(executor_id, 0)
+        logger.info("replacement registered for node %d as %s:%d (%s, incarnation %d)",
+                    executor_id, job_name, task_index, meta.get("host"), incarnation)
+        return {"ok": True, "executor_id": executor_id, "job_name": job_name,
+                "task_index": task_index, "expected": self.expected,
+                "incarnation": incarnation}
 
     def _op_reduce(self, msg: dict) -> dict:
         name, kind, value = msg["name"], msg.get("kind", "gather"), msg.get("value")
@@ -368,9 +488,17 @@ class CoordinatorClient:
 
     def __init__(self, address: tuple[str, int], connect_timeout: float = 30.0,
                  authkey: bytes | None = None):
+        from tensorflowonspark_tpu.utils.envtune import env_int
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+
         self.address = (address[0], int(address[1]))
         self._lock = threading.Lock()
-        self._sock = socket.create_connection(self.address, timeout=connect_timeout)
+        # Backoff on the dial (TOS_CONNECT_ATTEMPTS): a single-shot connect
+        # fails hard during a coordinator restart window or early-boot race;
+        # the elastic layer leans on clients riding that window out.
+        self._sock = connect_with_backoff(
+            self.address, timeout=connect_timeout,
+            attempts=env_int("TOS_CONNECT_ATTEMPTS", 3))
         if authkey is not None:
             from tensorflowonspark_tpu.utils.net import hmac_handshake_client
 
@@ -389,8 +517,25 @@ class CoordinatorClient:
                 raise ConnectionError("coordinator rejected authkey")
         self._sock.settimeout(None)
         self._gen = 0
+        self._executor_id: int | None = None
+        self._incarnation = 0
+
+    def set_identity(self, executor_id: int, incarnation: int = 0) -> None:
+        """Adopt the registration-assigned identity: every subsequent message
+        is stamped with (executor_id, incarnation) so the coordinator can
+        fence this client the moment its slot is declared dead and handed to
+        a replacement."""
+        self._executor_id = int(executor_id)
+        self._incarnation = int(incarnation)
+
+    def _stamp(self, msg: dict) -> dict:
+        if self._executor_id is not None and msg.get("op") != "register":
+            msg.setdefault("executor_id", self._executor_id)
+            msg.setdefault("incarnation", self._incarnation)
+        return msg
 
     def _call(self, msg: dict) -> dict:
+        msg = self._stamp(msg)
         with self._lock:
             _send_msg(self._sock, msg)
             return _recv_msg(self._sock)
@@ -400,9 +545,14 @@ class CoordinatorClient:
             raise RuntimeError(f"coordinator error: {resp.get('error')}")
         return resp
 
-    def register(self, meta: dict) -> dict:
-        """Register this node; returns assigned identity {executor_id, job_name, task_index}."""
-        return self._check(self._call({"op": "register", "meta": meta}))
+    def register(self, meta: dict, replace: int | None = None) -> dict:
+        """Register this node; returns assigned identity {executor_id,
+        job_name, task_index, incarnation}.  ``replace`` re-registers a
+        supervised restart into the named (dead) executor slot."""
+        msg: dict = {"op": "register", "meta": meta}
+        if replace is not None:
+            msg["replace"] = int(replace)
+        return self._check(self._call(msg))
 
     def await_cluster(self, timeout: float | None = None, poll: float = 0.1) -> list[dict]:
         """Poll QUERY until all nodes registered, then fetch cluster info (QINFO)."""
@@ -441,8 +591,9 @@ class CoordinatorClient:
         self._lock.acquire()
         sent = False
         try:
-            _send_msg(self._sock, {"op": "reduce", "name": name, "value": value,
-                                   "kind": kind, "timeout": timeout, "count": count})
+            _send_msg(self._sock, self._stamp(
+                {"op": "reduce", "name": name, "value": value,
+                 "kind": kind, "timeout": timeout, "count": count}))
             sent = True
         finally:
             if not sent:

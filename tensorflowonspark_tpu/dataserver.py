@@ -36,6 +36,7 @@ import threading
 from time import monotonic as _monotonic
 from typing import Any, Iterable
 
+from tensorflowonspark_tpu import faultinject
 from tensorflowonspark_tpu.feeding import FeedQueues
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 
@@ -134,6 +135,12 @@ class DataServer:
                 msg = _recv(conn)
                 try:
                     reply = self._handle(msg)
+                except faultinject.FaultInjected:
+                    # Chaos hook `sever`: drop the connection with NO reply —
+                    # exactly what a mid-partition socket loss looks like to
+                    # the driver (the node itself stays healthy).
+                    logger.warning("fault injection: severing data connection")
+                    return
                 except Exception as e:  # surface handler errors to the driver
                     logger.exception("dataserver op failed")
                     reply = ("err", f"{type(e).__name__}: {e}")
@@ -168,6 +175,9 @@ class DataServer:
 
     def _handle(self, msg: tuple) -> tuple:
         op = msg[0]
+        if op in ("feed", "infer_send"):
+            # may raise FaultInjected when a `sever` action is armed
+            faultinject.data_op()
         if op == "feed":
             _, qname, items = msg
             if self.queues.get("state") == "terminating":
@@ -180,10 +190,29 @@ class DataServer:
             return ("ok", "running")
         if op == "end_partition":
             # data-integrity marker mid-stream: bounded wait, surface stalls
-            state = self._put_responsive(self.queues.get_queue(msg[1]), EndPartition())
+            # Snapshot the watermark BEFORE the marker is queued: once the
+            # EndPartition is poppable, a fast map_fun can consume this very
+            # partition before the reply is built, and a report that already
+            # includes it would make the ledger's first-ack anchor strand a
+            # ghost entry in its delivered window (the tail drain would then
+            # stall on work that was consumed all along).  Reading early only
+            # lags the watermark — over-requeue on death, never loss.
+            consumed = self.queues.partitions_consumed(msg[1])
+            state = self._put_responsive(
+                self.queues.get_queue(msg[1]),
+                EndPartition(msg[2] if len(msg) > 2 else None))
             if state is not None and state[0] == "err":
                 return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
-            return ("ok",)
+            # reply carries the consumption watermark: how many partitions the
+            # map_fun has fully drained so far — the driver's ledger uses it
+            # to bound what a sudden death can take down with the queue
+            return ("ok", consumed)
+        if op == "consumed":
+            # standalone watermark read: after the last feed ack there are no
+            # more end_partition replies to carry it, and the driver's tail
+            # drain (elastic train) polls this until the buffered window is
+            # known-consumed
+            return ("ok", self.queues.partitions_consumed(msg[1]))
         if op == "eof":
             # Shutdown marker.  A full queue usually just means backpressure
             # (consumer alive but behind) — wait briefly for space so no
@@ -287,6 +316,12 @@ class DataServer:
                     unlinked = True
                 try:
                     reply = self._handle(msg)
+                except faultinject.FaultInjected:
+                    # `sever` on the ring path: abandon the ring with no
+                    # reply (finally runs close_write, so the driver sees a
+                    # dead data plane, mirroring the TCP sever).
+                    logger.warning("fault injection: severing ring data plane")
+                    return
                 except Exception as e:  # noqa: BLE001 - mirror TCP behaviour
                     logger.exception("dataserver ring op failed")
                     reply = ("err", f"{type(e).__name__}: {e}")
@@ -337,7 +372,8 @@ class DataClient:
 
     def __init__(self, host: str, port: int, authkey: bytes, chunk_size: int = 512,
                  prefer_ring: bool = True, ring_capacity: int = 64 * 1024 * 1024,
-                 call_timeout: float = 660.0, stall_timeout: float = 600.0):
+                 call_timeout: float = 660.0, stall_timeout: float = 600.0,
+                 connect_timeout: float = 60.0, connect_attempts: int | None = None):
         self.chunk_size = chunk_size
         self.ring_capacity = ring_capacity
         # Inference stall budget: infer_partition raises when no item was
@@ -351,9 +387,22 @@ class DataClient:
         # the ring's closed flag is never set, and an infinite wait would
         # wedge the whole driver data plane inside self._lock.
         self.call_timeout = call_timeout
-        self._sock = socket.create_connection((host, port), timeout=60.0)
+        from tensorflowonspark_tpu.utils.envtune import env_int
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+
+        # Backoff on the dial (TOS_CONNECT_ATTEMPTS): a node mid-restart has
+        # its data port dark for the backoff + re-register window; a
+        # single-shot connect would turn every recovery into a hard failure.
+        # Recovery loops that poll dial with short connect_timeout /
+        # connect_attempts=1 instead, so one blackholed host cannot pin them
+        # past their own deadline.
+        self._sock = connect_with_backoff(
+            (host, port), timeout=connect_timeout,
+            attempts=(connect_attempts if connect_attempts is not None
+                      else env_int("TOS_CONNECT_ATTEMPTS", 3)))
         self._sock.settimeout(None)
         self._lock = threading.Lock()
+        self._consumed_reported: dict[str, int] = {}
         if not _hmac_handshake_client(self._sock, authkey):
             self._sock.close()
             raise RuntimeError("data plane error: auth handshake failed")
@@ -442,8 +491,13 @@ class DataClient:
                     pass
             self._c2s = self._s2c = None
 
-    def feed_partition(self, items: Iterable[Any], qname: str = "input") -> str:
-        """Stream one partition; returns final node state ('running'/'terminating')."""
+    def feed_partition(self, items: Iterable[Any], qname: str = "input",
+                       task_key: Any = None) -> str:
+        """Stream one partition; returns final node state
+        ('running'/'terminating').  ``task_key`` identifies the logical
+        partition (the driver ledger's (epoch, partition)) so the node's
+        consumption watermark counts an at-least-once re-feed of the same
+        partition exactly once (see ``marker.EndPartition``)."""
         state = "running"
         chunk: list = []
         for item in items:
@@ -455,8 +509,22 @@ class DataClient:
                     break  # consumer is done; drop the rest fast
         if chunk and state != "terminating":
             state = self._call(("feed", qname, chunk))[1]
-        self._call(("end_partition", qname))
+        reply = self._call(("end_partition", qname, task_key))
+        if len(reply) > 1:
+            # node's consumption watermark as of this partition's EndPartition
+            # placement (see DataServer end_partition)
+            self._consumed_reported[qname] = int(reply[1])
         return state
+
+    def partitions_consumed(self, qname: str = "input") -> int | None:
+        """The node's cumulative fully-consumed-partition count as of the
+        last ``feed_partition`` ack on ``qname`` (None before the first)."""
+        return self._consumed_reported.get(qname)
+
+    def poll_consumed(self, qname: str = "input", timeout: float = 10.0) -> int:
+        """Round-trip the node's CURRENT consumption watermark (tail-drain
+        path: no feed acks are left to piggyback it on)."""
+        return int(self._call(("consumed", qname), timeout=timeout)[1])
 
     def infer_partition(self, items: Iterable[Any], qname_in: str = "input", qname_out: str = "output") -> list:
         """Round-trip one partition; returns exactly-count ordered results.
@@ -498,11 +566,34 @@ class DataClient:
                     f"{len(items)} results before {self.stall_timeout}s stall timeout")
         return results
 
-    def send_eof(self, qname: str = "input", timeout: float = 20.0) -> None:
+    def send_eof(self, qname: str = "input", timeout: float | None = None) -> None:
         """EOF is a teardown-path control message: the node replies within
         milliseconds or is gone — never wait the full feed timeout on it
-        (a node may exit between the driver's liveness check and this call)."""
+        (a node may exit between the driver's liveness check and this call).
+        Default budget 20s, env-overridable via ``TOS_EOF_TIMEOUT``."""
+        if timeout is None:
+            from tensorflowonspark_tpu.utils.envtune import env_float
+
+            timeout = env_float("TOS_EOF_TIMEOUT", 20.0)
         self._call(("eof", qname), timeout=timeout)
+
+    def abort(self) -> None:
+        """Lockless immediate teardown (the monitor's death path): wake any
+        thread wedged inside ``_call`` by shutting the socket down under it.
+        ``close()`` would first wait on the per-client lock that thread holds
+        for its full call timeout (~11 min against a dead ring peer) —
+        exactly the stall a death declaration exists to cut short."""
+        c2s, s2c = self._c2s, self._s2c
+        self._c2s = self._s2c = None
+        if c2s is not None:
+            with contextlib.suppress(Exception):
+                c2s.close_write()
+                c2s.detach()
+                s2c.detach()
+        with contextlib.suppress(OSError):
+            self._sock.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
+            self._sock.close()
 
     def close(self) -> None:
         if self._c2s is not None:

@@ -1,0 +1,172 @@
+"""Deterministic, env-gated fault injection for the recovery paths.
+
+The elastic-recovery layer (``supervisor.py``, the partition ledger in
+``cluster.py``, incarnation fencing in ``coordinator.py``) is only trustworthy
+if every recovery path runs in fast tier-1 tests — not just in soak runs that
+happen to hit a flake.  This module plants three chaos hooks at the exact
+seams a real failure would hit, all disabled unless ``TOS_FAULTINJECT`` is
+set (typically via ``per_node_env``, so one node of a test cluster misbehaves
+deterministically while its peers stay healthy):
+
+- ``kill`` — SIGKILL this node after its map_fun consumed N feed batches
+  (hook: ``feeding.DataFeed.next_batch``).  Models an OOM kill / preemption
+  mid-epoch: no deregister, no error report, just silence.
+- ``drop_heartbeats`` — swallow the first K liveness pings (hook: the
+  heartbeat loop in ``node.py``).  Models a network partition: the process
+  lives on as a *zombie* the coordinator has declared dead, which is exactly
+  what incarnation fencing exists for.
+- ``sever`` — abruptly close the node's data-plane connection on the M-th
+  data-carrying op (hook: ``dataserver.DataServer``).  Models a mid-partition
+  socket loss with the node still healthy; the driver must requeue and refeed.
+
+Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
+``name:key=value,key=value`` —
+
+    TOS_FAULTINJECT="kill:after_batches=3,incarnation=0"
+    TOS_FAULTINJECT="drop_heartbeats:count=8;sever:after_data_ops=2"
+
+Common keys: ``executor=E`` fires only on that executor id (ids are assigned
+at registration, so per-node targeting usually rides ``per_node_env``
+instead); ``incarnation=I`` fires only at that node incarnation — the idiom
+for "die once": a restarted node re-parses the same env but its incarnation
+moved on, so the fault stays disarmed.  Counters are plain in-process
+counts — same schedule every run.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "TOS_FAULTINJECT"
+
+
+class FaultInjected(Exception):
+    """Raised by hooks that simulate infrastructure faults (e.g. ``sever``);
+    handlers treat it as the fault itself, never as a handler bug."""
+
+
+class _Action:
+    __slots__ = ("name", "threshold", "executor", "incarnation", "fired", "count")
+
+    def __init__(self, name: str, threshold: int,
+                 executor: int | None, incarnation: int | None):
+        self.name = name
+        self.threshold = threshold
+        self.executor = executor
+        self.incarnation = incarnation
+        self.fired = False
+        self.count = 0
+
+
+class FaultPlan:
+    """Parsed ``TOS_FAULTINJECT`` spec with deterministic counters."""
+
+    _KEYS = {"kill": "after_batches",
+             "drop_heartbeats": "count",
+             "sever": "after_data_ops"}
+    # one-shot actions fire once when the counter REACHES the threshold;
+    # windowed actions fire on EVERY call until the threshold is spent
+    # (drop_heartbeats swallows the first K pings — one dropped ping would
+    # never outlast the driver's dead-node timeout)
+    _WINDOWED = frozenset({"drop_heartbeats"})
+
+    def __init__(self, actions: list[_Action]):
+        self._lock = threading.Lock()
+        self._actions = actions
+        self._executor_id: int | None = None
+        self._incarnation = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        actions: list[_Action] = []
+        for chunk in spec.split(";"):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            name, _, rest = chunk.partition(":")
+            name = name.strip()
+            if name not in cls._KEYS:
+                raise ValueError(f"unknown fault action {name!r} in {spec!r}")
+            kv = {}
+            for pair in filter(None, (p.strip() for p in rest.split(","))):
+                k, _, v = pair.partition("=")
+                kv[k.strip()] = int(v)
+            threshold = kv.pop(cls._KEYS[name], 1)
+            executor = kv.pop("executor", None)
+            incarnation = kv.pop("incarnation", None)
+            if kv:
+                raise ValueError(f"unknown keys {sorted(kv)} for fault {name!r}")
+            actions.append(_Action(name, threshold, executor, incarnation))
+        return cls(actions)
+
+    def set_identity(self, executor_id: int, incarnation: int = 0) -> None:
+        with self._lock:
+            self._executor_id = executor_id
+            self._incarnation = incarnation
+
+    def _tick(self, name: str) -> bool:
+        """Advance the named action's counter; True when it fires this call."""
+        with self._lock:
+            for a in self._actions:
+                if a.name != name or a.fired:
+                    continue
+                if a.executor is not None and a.executor != self._executor_id:
+                    continue
+                if a.incarnation is not None and a.incarnation != self._incarnation:
+                    continue
+                a.count += 1
+                if a.name in self._WINDOWED:
+                    if a.count >= a.threshold:
+                        a.fired = True
+                    return True
+                if a.count >= a.threshold:
+                    a.fired = True
+                    return True
+        return False
+
+
+_PLAN: FaultPlan | None = None
+
+
+def init_from_env(force: bool = False) -> None:
+    """Parse ``TOS_FAULTINJECT`` (call after per-node env is applied)."""
+    global _PLAN
+    if _PLAN is not None and not force:
+        return
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        _PLAN = None
+        return
+    _PLAN = FaultPlan.parse(spec)
+    logger.warning("fault injection armed: %s=%r", ENV_VAR, spec)
+
+
+def set_identity(executor_id: int, incarnation: int = 0) -> None:
+    if _PLAN is not None:
+        _PLAN.set_identity(executor_id, incarnation)
+
+
+def batch_consumed() -> None:
+    """Hook: one feed batch fully consumed by the map_fun.  ``kill`` fires
+    here with SIGKILL — the most brutal death available: no atexit, no
+    deregister, no flush, exactly what a preempted VM looks like."""
+    if _PLAN is not None and _PLAN._tick("kill"):
+        logger.warning("fault injection: SIGKILL self (pid %d)", os.getpid())
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def drop_heartbeat() -> bool:
+    """Hook: about to send a liveness ping; True = swallow it."""
+    return _PLAN is not None and _PLAN._tick("drop_heartbeats")
+
+
+def data_op() -> None:
+    """Hook: a data-carrying op (feed / infer_send) reached the node's data
+    server; ``sever`` raises so the connection closes with no reply."""
+    if _PLAN is not None and _PLAN._tick("sever"):
+        raise FaultInjected("severing data-plane connection (TOS_FAULTINJECT)")

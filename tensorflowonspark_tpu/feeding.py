@@ -32,6 +32,7 @@ import queue
 import threading
 from typing import Any, Iterable, Sequence
 
+from tensorflowonspark_tpu import faultinject
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker
 
 
@@ -45,6 +46,16 @@ class FeedQueues:
     def __init__(self, qnames: Sequence[str] = ("input", "output", "error"), capacity: int = 1024):
         self._queues: dict[str, queue.Queue] = {name: queue.Queue(maxsize=capacity) for name in qnames}
         self._state: dict[str, Any] = {"state": "running"}
+        # Cumulative partitions fully CONSUMED (EndPartition popped by the
+        # map_fun) per queue — the consumption watermark the data server
+        # reports back to the driver, so the partition ledger knows which
+        # buffered-but-unconsumed partitions die with this process.  Keyed
+        # markers dedupe: an at-least-once re-feed can place two
+        # EndPartitions for ONE logical partition in this queue (reply lost
+        # after the server queued the first marker), and double-counting
+        # would over-advance the driver's watermark past still-buffered work.
+        self._consumed: dict[str, int] = {name: 0 for name in qnames}
+        self._consumed_keys: dict[str, set] = {name: set() for name in qnames}
         self._lock = threading.Lock()
 
     def get_queue(self, qname: str) -> queue.Queue:
@@ -52,6 +63,19 @@ class FeedQueues:
             return self._queues[qname]
         except KeyError:
             raise KeyError(f"unknown queue {qname!r}; have {sorted(self._queues)}") from None
+
+    def note_partition_consumed(self, qname: str, key=None) -> None:
+        with self._lock:
+            if key is not None:
+                seen = self._consumed_keys.setdefault(qname, set())
+                if key in seen:
+                    return  # re-fed duplicate of a partition already counted
+                seen.add(key)
+            self._consumed[qname] = self._consumed.get(qname, 0) + 1
+
+    def partitions_consumed(self, qname: str) -> int:
+        with self._lock:
+            return self._consumed.get(qname, 0)
 
     def set(self, key: str, value: Any) -> None:
         with self._lock:
@@ -117,6 +141,17 @@ class DataFeed:
         # end-of-feed.
         self.stop_event = stop_event
         self.poll_interval = poll_interval
+        # Markers of partitions whose CLOSING batch has been built but not
+        # yet returned to (and processed by) the map_fun.  Counting them
+        # consumed at EndPartition-pop time would let the watermark race
+        # ahead of the map_fun: a death between the pop and the map_fun's
+        # processing of that final batch would advance the driver's ledger
+        # past a partition whose tail items were never seen — silent loss,
+        # where the contract is duplicates-allowed-loss-never.  Reported on
+        # the NEXT next_batch call instead (the map_fun coming back for more
+        # is the proof the previous batch was handed over); the watermark
+        # only ever lags, which can over-requeue but never drop.
+        self._closed_unreported: list = []
 
     # -- consuming -----------------------------------------------------------
 
@@ -125,6 +160,9 @@ class DataFeed:
 
         Reference hot loop ``TFNode.py:~280-340``.
         """
+        for key in self._closed_unreported:
+            self.queues.note_partition_consumed(self.qname_in, key)
+        self._closed_unreported = []
         q = self.queues.get_queue(self.qname_in)
         batch: list = []
         while len(batch) < batch_size:
@@ -136,15 +174,29 @@ class DataFeed:
                     break
                 continue
             if isinstance(item, EndPartition):
+                # the marker is FIFO-last for its partition: popping it means
+                # every item of that partition left the queue
                 if batch:
+                    # the batch closing this partition still has to reach the
+                    # map_fun — defer the consumption report (see __init__)
+                    self._closed_unreported.append(getattr(item, "key", None))
                     break  # partial batch closes out the partition
-                continue  # empty partition: keep waiting for real data
+                # empty close: every item of this partition was in batches
+                # returned on earlier calls, all fully processed by now
+                self.queues.note_partition_consumed(self.qname_in,
+                                                    getattr(item, "key", None))
+                continue  # keep waiting for real data
             if isinstance(item, EndOfFeed):
                 self.done_feeding = True
                 break
             if isinstance(item, Marker):
                 continue
             batch.append(item)
+        if batch:
+            # Chaos hook (no-op unless TOS_FAULTINJECT armed a `kill`): a
+            # consumed batch is the deterministic clock for "die after N
+            # batches" — the most brutal mid-epoch death available.
+            faultinject.batch_consumed()
         if self.input_mapping:
             return self._to_columns(batch)
         return batch

@@ -52,7 +52,40 @@ def _child_entry(payload: bytes, log_path: str | None) -> None:
     sys.exit(node_main(config))
 
 
-class LocalLauncher:
+class _RespawnMixin:
+    """Shared supervised-restart scaffolding: launch-time config capture and
+    the reap-then-respawn of one slot.  Subclasses provide ``_spawn_one`` and
+    a ``self._procs`` list of handles exposing
+    ``is_alive/terminate/kill/join``."""
+
+    def _remember_launch(self, configs: Sequence["NodeConfig"],
+                         log_dir: str | None) -> None:
+        self._configs = list(configs)
+        self._log_dir = log_dir
+
+    @property
+    def configs(self) -> list["NodeConfig"]:
+        """The per-slot NodeConfigs of the most recent launch()."""
+        return list(self._configs)
+
+    def respawn(self, index: int, config: "NodeConfig | None" = None) -> None:
+        """Replace the process at ``index`` with a fresh one (supervised
+        restart path).  Reaps the predecessor FIRST — terminate, then kill —
+        so a zombie (alive but fenced) can never share the slot's ports or
+        accelerators with its replacement; the old handle (and its exit
+        code) is dropped, keeping shutdown's exit-code audit about the
+        processes that finished the job."""
+        old = self._procs[index]
+        if old.is_alive():
+            old.terminate()
+            old.join(5.0)
+            if old.is_alive():
+                old.kill()
+        old.join(5.0)
+        self._procs[index] = self._spawn_one(index, config or self._configs[index])
+
+
+class LocalLauncher(_RespawnMixin):
     """Spawn node processes on the local host.
 
     Uses the 'spawn' start method: forking a process after JAX/XLA has
@@ -69,6 +102,8 @@ class LocalLauncher:
     def __init__(self, env: dict[str, str] | None = None):
         self.env = dict(env or {})
         self._procs: list[mp.Process] = []
+        self._configs: list[NodeConfig] = []
+        self._log_dir: str | None = None
 
     def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
         # Re-launchable: a fresh cluster must not inherit handles of a
@@ -79,15 +114,19 @@ class LocalLauncher:
         if any(p.is_alive() for p in self._procs):
             self.terminate()
         self._procs = []
-        ctx = mp.get_context("spawn")
+        self._remember_launch(configs, log_dir)
         for i, config in enumerate(configs):
             config.env = {**self.env, **config.env}
-            log_path = os.path.join(log_dir, f"node_{i}.log") if log_dir else None
-            payload = cloudpickle.dumps(config)
-            p = ctx.Process(target=_child_entry, args=(payload, log_path), name=f"tpu-node-{i}")
-            p.daemon = False
-            p.start()
-            self._procs.append(p)
+            self._procs.append(self._spawn_one(i, config))
+
+    def _spawn_one(self, i: int, config: NodeConfig) -> mp.Process:
+        ctx = mp.get_context("spawn")
+        log_path = os.path.join(self._log_dir, f"node_{i}.log") if self._log_dir else None
+        payload = cloudpickle.dumps(config)
+        p = ctx.Process(target=_child_entry, args=(payload, log_path), name=f"tpu-node-{i}")
+        p.daemon = False
+        p.start()
+        return p
 
     @property
     def processes(self) -> list[mp.Process]:
@@ -172,7 +211,7 @@ def _pythonpath_env() -> dict[str, str]:
     return {"PYTHONPATH": os.pathsep.join(entries)}
 
 
-class SubprocessLauncher:
+class SubprocessLauncher(_RespawnMixin):
     """Spawn node processes as fresh OS subprocesses with per-node env.
 
     Each child runs ``python -m tensorflowonspark_tpu.launcher`` and reads
@@ -185,31 +224,37 @@ class SubprocessLauncher:
     def __init__(self, env: dict[str, str] | None = None):
         self.env = dict(env or {})
         self._procs: list[PopenHandle] = []
+        self._configs: list[NodeConfig] = []
+        self._log_dir: str | None = None
 
     def launch(self, configs: Sequence[NodeConfig], log_dir: str | None = None) -> None:
         if any(p.is_alive() for p in self._procs):
             self.terminate()  # re-launchable (see LocalLauncher.launch)
         self._procs = []
+        self._remember_launch(configs, log_dir)
         for i, config in enumerate(configs):
             config.env = {**self.env, **config.env}
-            child_env = {**os.environ, **_pythonpath_env(), **config.env}
-            if log_dir:
-                log_f = open(os.path.join(log_dir, f"node_{i}.log"), "ab", buffering=0)
-            else:
-                log_f = None
-            payload = cloudpickle.dumps(config)
-            proc = subprocess.Popen(
-                _node_command(),
-                stdin=subprocess.PIPE,
-                stdout=log_f if log_f else None,
-                stderr=subprocess.STDOUT if log_f else None,
-                env=child_env,
-            )
-            proc.stdin.write(payload)
-            proc.stdin.close()
-            if log_f is not None:
-                log_f.close()  # child holds its own fd now
-            self._procs.append(PopenHandle(proc))
+            self._procs.append(self._spawn_one(i, config))
+
+    def _spawn_one(self, i: int, config: NodeConfig) -> PopenHandle:
+        child_env = {**os.environ, **_pythonpath_env(), **config.env}
+        if self._log_dir:
+            log_f = open(os.path.join(self._log_dir, f"node_{i}.log"), "ab", buffering=0)
+        else:
+            log_f = None
+        payload = cloudpickle.dumps(config)
+        proc = subprocess.Popen(
+            _node_command(),
+            stdin=subprocess.PIPE,
+            stdout=log_f if log_f else None,
+            stderr=subprocess.STDOUT if log_f else None,
+            env=child_env,
+        )
+        proc.stdin.write(payload)
+        proc.stdin.close()
+        if log_f is not None:
+            log_f.close()  # child holds its own fd now
+        return PopenHandle(proc)
 
     @property
     def processes(self) -> list[PopenHandle]:
@@ -234,7 +279,7 @@ class SubprocessLauncher:
                 p.kill()
 
 
-class TPUPodLauncher:
+class TPUPodLauncher(_RespawnMixin):
     """Placement across the hosts of a TPU pod slice.
 
     One node process per TPU-VM host; each process sees that host's chips
@@ -281,6 +326,8 @@ class TPUPodLauncher:
         self.platform = platform
         self.simulate_chips = simulate_chips
         self._procs: list[PopenHandle] = []
+        self._configs: list[NodeConfig] = []
+        self._log_dir: str | None = None
 
     # -- env composition -----------------------------------------------------
 
@@ -339,18 +386,31 @@ class TPUPodLauncher:
         if any(p.is_alive() for p in self._procs):
             self.terminate()  # re-launchable (see LocalLauncher.launch)
         self._procs = []
+        self._remember_launch(configs, log_dir)
         for i, (host, config) in enumerate(zip(self.hosts, configs)):
             config.jax_distributed = True  # a pod IS a jax.distributed job
             config.env = {**self.host_env(i), **config.env}
-            log_f = None
-            if log_dir:
-                log_f = open(os.path.join(log_dir, f"node_{i}.log"), "ab", buffering=0)
-            payload = cloudpickle.dumps(config)
-            try:
-                self._procs.append(self._spawn(host, config.env, payload, log_f))
-            finally:
-                if log_f is not None:
-                    log_f.close()
+            self._procs.append(self._spawn_one(i, config))
+
+    def _spawn_one(self, i: int, config: NodeConfig) -> PopenHandle:
+        log_f = None
+        if self._log_dir:
+            log_f = open(os.path.join(self._log_dir, f"node_{i}.log"), "ab", buffering=0)
+        payload = cloudpickle.dumps(config)
+        try:
+            return self._spawn(self.hosts[i], config.env, payload, log_f)
+        finally:
+            if log_f is not None:
+                log_f.close()
+
+    def respawn(self, index: int, config: NodeConfig | None = None) -> None:
+        """A pod is one ``jax.distributed`` job — a restarted process cannot
+        rejoin the live XLA world, so there is nothing a per-slot respawn
+        could correctly do (``cluster.run`` refuses ``elastic`` with this
+        launcher up front; this guard catches direct callers)."""
+        raise NotImplementedError(
+            "TPUPodLauncher cannot respawn a single slot of a live "
+            "jax.distributed pod; relaunch the whole pod instead")
 
     @property
     def processes(self) -> list[PopenHandle]:

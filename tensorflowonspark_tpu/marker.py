@@ -18,9 +18,22 @@ class Marker:
 
 
 class EndPartition(Marker):
-    """End of a single streamed partition (reference ``marker.EndPartition``)."""
+    """End of a single streamed partition (reference ``marker.EndPartition``).
 
-    __slots__ = ()
+    ``key`` (optional) identifies WHICH logical partition this closes — the
+    driver's ledger task, e.g. ``(epoch, partition)``.  The at-least-once
+    re-feed path can legitimately place two EndPartitions for one logical
+    partition in the same queue (end_partition reply lost after the server
+    already queued the marker, then the same partition re-fed); the
+    consumption watermark must count such a pair once, or it over-advances
+    past still-buffered work that a later death would then fail to
+    re-deliver.  ``None`` (legacy/no-ledger feeds) counts every pop.
+    """
+
+    __slots__ = ("key",)
+
+    def __init__(self, key=None):
+        self.key = key
 
 
 class EndOfFeed(Marker):

@@ -69,6 +69,10 @@ class NodeConfig:
     # (pids don't work for that: over ssh transports the local handle's pid
     # is the ssh client, not the remote node).
     launch_index: int = -1
+    # >= 0: this process is a supervised RESTART re-registering into the
+    # named (dead) executor slot; it adopts the slot's bumped incarnation,
+    # fencing out its predecessor (supervisor.py).
+    replace_executor_id: int = -1
 
 
 class NodeContext:
@@ -86,10 +90,15 @@ class NodeContext:
         config: NodeConfig,
         client: CoordinatorClient,
         stop_event: threading.Event | None = None,
+        incarnation: int = 0,
     ):
         self.executor_id = executor_id
         self.job_name = job_name
         self.task_index = task_index
+        # 0 for a first-launch node; a supervised restart adopts its slot's
+        # bumped generation (map_funs can key restart-only behaviour on it,
+        # e.g. "resume from the latest checkpoint").
+        self.incarnation = incarnation
         self.num_executors = num_executors
         self.cluster_info = cluster_info
         self.queues = queues
@@ -104,6 +113,13 @@ class NodeContext:
         # shared with the heartbeat thread, which starts before this context
         # exists (liveness must not wait for jax init / first compiles)
         self.stop_requested = stop_event if stop_event is not None else threading.Event()
+
+    @property
+    def is_restart(self) -> bool:
+        """True when this node is a supervised restart of a dead predecessor
+        — the cue to resume from the latest checkpoint
+        (``checkpoint.restore_for_restart``) before re-entering the feed."""
+        return self.incarnation > 0
 
     # -- data plane ----------------------------------------------------------
 
@@ -195,6 +211,7 @@ class NodeContext:
         if self._cons_client is None:
             self._cons_client = CoordinatorClient(self._config.coordinator_addr,
                                                   authkey=self._config.authkey)
+            self._cons_client.set_identity(self.executor_id, self.incarnation)
         return self._cons_client
 
     def _reset_consensus_client(self) -> None:
@@ -292,6 +309,11 @@ def node_main(config: NodeConfig) -> int:
         format="%(asctime)s %(levelname)s [node %(process)d] %(name)s: %(message)s",
         force=True,
     )
+    from tensorflowonspark_tpu import faultinject
+
+    # Chaos hooks arm only AFTER per-node env landed (per_node_env is how a
+    # test makes exactly one node of a cluster misbehave).
+    faultinject.init_from_env(force=True)
 
     client = CoordinatorClient(config.coordinator_addr, authkey=config.authkey)
     queues = FeedQueues(config.queues, config.queue_capacity)
@@ -308,8 +330,16 @@ def node_main(config: NodeConfig) -> int:
                    if config.jax_distributed else tpu_info.device_summary())
     ident = client.register({"host": local_ip(), "data_port": data_port,
                              "pid": os.getpid(), "device": device_meta,
-                             "launch_index": config.launch_index})
+                             "launch_index": config.launch_index},
+                            replace=(config.replace_executor_id
+                                     if config.replace_executor_id >= 0 else None))
     executor_id = ident["executor_id"]
+    incarnation = int(ident.get("incarnation", 0))
+    # Every control-plane message from here carries this identity, so a
+    # zombie predecessor of this slot (or this process, once IT is declared
+    # dead) is fenced by the coordinator instead of racing its replacement.
+    client.set_identity(executor_id, incarnation)
+    faultinject.set_identity(executor_id, incarnation)
     cluster_info = client.await_cluster(timeout=config.reservation_timeout)
 
     # Heartbeats must start IMMEDIATELY after registration — before
@@ -335,6 +365,7 @@ def node_main(config: NodeConfig) -> int:
                 hb_client = CoordinatorClient(config.coordinator_addr,
                                               authkey=config.authkey,
                                               connect_timeout=3.0)
+                hb_client.set_identity(executor_id, incarnation)
                 break
             except Exception:
                 time.sleep(0.5 * (attempt + 1))
@@ -354,6 +385,12 @@ def node_main(config: NodeConfig) -> int:
             return
         failures = 0
         while not stop_requested.is_set():
+            if faultinject.drop_heartbeat():
+                # Chaos hook: swallow this liveness ping (models a network
+                # partition — the process lives on as a zombie the driver
+                # will declare dead; incarnation fencing handles the rest).
+                time.sleep(config.heartbeat_interval)
+                continue
             try:
                 stop = hb_client.heartbeat(executor_id)
                 failures = 0
@@ -449,6 +486,7 @@ def node_main(config: NodeConfig) -> int:
         config=config,
         client=client,
         stop_event=stop_requested,
+        incarnation=incarnation,
     )
 
     exit_code = 0

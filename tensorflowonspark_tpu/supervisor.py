@@ -1,0 +1,228 @@
+"""Supervised worker restart — the elastic half of fault tolerance.
+
+The reference outsourced this to Spark: a dead executor's task was rerun by
+the scheduler, and the reservation server simply saw a fresh registration
+(PAPER.md §5.3).  With no Spark layer, detection already lives in the driver
+(heartbeats → ``CoordinatorServer.dead_nodes`` → the cluster monitor); this
+module adds *recovery*: when the monitor declares a data node dead, the
+supervisor reaps whatever is left of the process, waits out a bounded
+exponential backoff (with jitter, so a correlated failure doesn't respawn a
+whole fleet in lockstep), and relaunches the node into the same slot via
+``launcher.respawn``.  The replacement re-registers with
+``replace_executor_id`` and adopts the slot's bumped *incarnation number* —
+the coordinator fences everything the dead predecessor might still send
+("TensorFlow: A system for large-scale machine learning" treats checkpoint
+restart as the baseline contract; TF-Replicator adds the generation fencing
+this implements).
+
+Classification keeps restarts honest:
+
+- a node that *reported a map_fun error* before dying failed on the
+  application, not the infrastructure — restarting would just crash-loop the
+  same bug, so the death stays fatal;
+- a node past ``max_restarts`` is permanently failed: the supervisor records
+  a node error (surfacing through the same channel map_fun errors use) and
+  signals stop, restoring the non-elastic fail-fast behaviour.
+
+Scope: restartable jobs are the streaming/DIRECT per-host-mesh kind.  A
+``jax.distributed`` job cannot readmit a process into a live XLA world —
+``cluster.run(elastic=...)`` refuses the combination up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+from tensorflowonspark_tpu.utils.envtune import env_float, env_int
+from tensorflowonspark_tpu.utils.net import backoff_delay
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Per-node restart budget + backoff schedule (env-overridable)."""
+
+    max_restarts: int = 2
+    backoff_base: float = 0.5
+    backoff_factor: float = 2.0
+    backoff_max: float = 10.0
+    jitter: float = 0.25
+
+    @classmethod
+    def from_env(cls) -> "RestartPolicy":
+        return cls(
+            max_restarts=env_int("TOS_MAX_RESTARTS", 2, minimum=0),
+            backoff_base=env_float("TOS_RESTART_BACKOFF_BASE", 0.5),
+            backoff_factor=env_float("TOS_RESTART_BACKOFF_FACTOR", 2.0),
+            backoff_max=env_float("TOS_RESTART_BACKOFF_MAX", 10.0),
+        )
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before restart ``attempt`` (0-based), jittered ±jitter."""
+        return backoff_delay(attempt, self.backoff_base, self.backoff_factor,
+                             self.backoff_max, self.jitter)
+
+
+class Supervisor:
+    """Watches launcher children and restarts failed nodes under a policy."""
+
+    def __init__(self, coordinator, launcher, policy: RestartPolicy | None = None):
+        self.coordinator = coordinator
+        self.launcher = launcher
+        self.policy = policy or RestartPolicy.from_env()
+        # How long a respawned replacement gets to re-register before the
+        # supervisor treats its boot as another death (the monitor can only
+        # re-detect nodes that made it into liveness tracking).
+        self._reregister_timeout = env_float("TOS_REREGISTER_TIMEOUT", 60.0)
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._restarts: dict[int, int] = {}
+        self._permanent: dict[int, str] = {}
+        self._inflight: set[int] = set()
+        self._threads: list[threading.Thread] = []
+
+    # -- status (consumed by the partition ledger's recovery waits) ----------
+
+    def permanently_failed(self, executor_id: int) -> str | None:
+        """The recorded reason when the slot is beyond recovery, else None."""
+        with self._lock:
+            return self._permanent.get(executor_id)
+
+    def restart_count(self, executor_id: int) -> int:
+        with self._lock:
+            return self._restarts.get(executor_id, 0)
+
+    def restarting(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._inflight
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def handle_death(self, executor_id: int) -> None:
+        """Non-blocking: schedule recovery of a node the monitor just
+        declared dead (its incarnation is already fenced).  Backoff and
+        respawn run on their own thread so one slot's restart window never
+        delays detection or recovery of its peers."""
+        if self._stopped.is_set():
+            return
+        with self._lock:
+            if executor_id in self._inflight or executor_id in self._permanent:
+                return
+            self._inflight.add(executor_id)
+            self._threads = [t for t in self._threads if t.is_alive()]
+            t = threading.Thread(target=self._restart, args=(executor_id,),
+                                 daemon=True, name=f"supervisor-restart-{executor_id}")
+            self._threads.append(t)
+        t.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """No restarts past this point (shutdown owns escalation now)."""
+        self._stopped.set()
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout)
+
+    # -- the restart path ----------------------------------------------------
+
+    def _fail_permanently(self, executor_id: int, reason: str) -> None:
+        with self._lock:
+            self._permanent[executor_id] = reason
+        logger.error("executor %d permanently failed: %s", executor_id, reason)
+        # Surface through the node-error channel and fail fast, exactly like
+        # the non-elastic path would have on first death.
+        self.coordinator.record_failure(executor_id, reason)
+        self.coordinator.signal_stop()
+
+    def _classify(self, executor_id: int, attempt: int) -> str | None:
+        """Reason this death is NOT restartable, else None."""
+        if attempt >= self.policy.max_restarts:
+            return (f"node {executor_id} exhausted its restart budget "
+                    f"({self.policy.max_restarts} restart(s)); giving up")
+        if any(e.get("executor_id") == executor_id for e in self.coordinator.errors()):
+            return (f"node {executor_id} reported a map_fun error before dying; "
+                    "an application failure is not restartable")
+        return None
+
+    def _await_reregister(self, executor_id: int) -> bool:
+        """True once the replacement is liveness-tracked (it re-registered);
+        False when the re-register window expires or the supervisor stops."""
+        deadline = time.monotonic() + self._reregister_timeout
+        while time.monotonic() < deadline and not self._stopped.is_set():
+            _, tracked = self.coordinator.registered_incarnation(executor_id)
+            if tracked:
+                return True
+            time.sleep(0.25)
+        return False
+
+    def _restart(self, executor_id: int) -> None:
+        try:
+            # Loop rather than fire-and-forget: a replacement that dies
+            # DURING BOOT (before registering) never enters liveness
+            # tracking, so the monitor cannot re-detect it — the supervisor
+            # itself must notice and spend the remaining budget on it.
+            while True:
+                attempt = self.restart_count(executor_id)
+                reason = self._classify(executor_id, attempt)
+                if reason is not None:
+                    self._fail_permanently(executor_id, reason)
+                    return
+                delay = self.policy.delay(attempt)
+                logger.warning("restarting executor %d in %.2fs (attempt %d/%d)",
+                               executor_id, delay, attempt + 1, self.policy.max_restarts)
+                if self._stopped.wait(delay):
+                    return
+                meta = self.coordinator.node_meta(executor_id)
+                launch_index = (meta or {}).get("launch_index", -1)
+                if not 0 <= launch_index < len(self.launcher.processes):
+                    self._fail_permanently(
+                        executor_id,
+                        f"node {executor_id} has no launch_index mapping; cannot respawn")
+                    return
+                config = dataclasses.replace(self.launcher.configs[launch_index],
+                                             replace_executor_id=executor_id)
+                # Last look before reaping: a replacement that booted slower
+                # than the re-register window (cold jax/TPU init) may have
+                # registered DURING the backoff we just waited out — killing
+                # it now would burn the budget on a recovered slot (and its
+                # stale liveness entry would make the next replacement's
+                # register(replace=...) be refused as still-tracked).  A
+                # registration landing in the microseconds between this check
+                # and respawn() is still reaped — that residual race is not
+                # closed, only narrowed: the reaped slot goes heartbeat-silent,
+                # the monitor re-declares the death, and recovery re-enters
+                # here at the cost of one extra budget unit.
+                _, tracked = self.coordinator.registered_incarnation(executor_id)
+                if tracked:
+                    logger.info("executor %d re-registered late; restart "
+                                "attempt %d not needed", executor_id, attempt + 1)
+                    return
+                with self._lock:
+                    if self._stopped.is_set():
+                        return
+                    self._restarts[executor_id] = attempt + 1
+                # respawn reaps the predecessor first: a fenced-but-alive
+                # zombie (network partition, dropped heartbeats) must release
+                # the slot's ports/devices before its replacement takes them.
+                self.launcher.respawn(launch_index, config)
+                logger.info("executor %d respawned (launch_index %d, restart %d)",
+                            executor_id, launch_index, attempt + 1)
+                if self._await_reregister(executor_id):
+                    return
+                if self._stopped.is_set():
+                    return
+                logger.warning(
+                    "replacement for executor %d died before re-registering "
+                    "(%.0fs window); treating as another death",
+                    executor_id, self._reregister_timeout)
+        except Exception:
+            logger.exception("supervised restart of executor %d failed", executor_id)
+            self._fail_permanently(
+                executor_id, f"supervised restart of node {executor_id} raised; see driver log")
+        finally:
+            with self._lock:
+                self._inflight.discard(executor_id)

@@ -1,0 +1,49 @@
+"""Env-tunable numeric knobs (reference: ``TFOS_SERVER_TIMEOUT``-style ops
+overrides, ``reservation.py:~120-160``): ops can raise fleet-wide budgets
+without touching job code.  Shared by the cluster, data plane, and the
+elastic-recovery layer so every timeout/retry default follows one pattern.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def env_float(name: str, default: float) -> float:
+    """Positive float from the environment, else ``default``.
+
+    0 is NOT "no timeout" for the knobs this serves — it would make every
+    bounded wait fail instantly; non-positive and junk values fall back to
+    the default with a warning instead.
+    """
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default
+    if value <= 0:
+        logger.warning("ignoring non-positive %s=%r", name, raw)
+        return default
+    return value
+
+
+def env_int(name: str, default: int, minimum: int = 1) -> int:
+    """Integer knob with a floor (retry/attempt counts must stay >= 1)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default
+    if value < minimum:
+        logger.warning("ignoring %s=%r below floor %d", name, raw, minimum)
+        return default
+    return value

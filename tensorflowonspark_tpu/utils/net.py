@@ -39,6 +39,52 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def backoff_delay(attempt: int, base: float, factor: float, max_delay: float,
+                  jitter: float = 0.25) -> float:
+    """Jittered exponential backoff before ``attempt`` (0-based): the one
+    formula behind every retry schedule here (dials, supervised restarts),
+    so tuning the shape tunes them all.  ±jitter decorrelates a fleet
+    retrying the same endpoint in lockstep."""
+    import random
+
+    delay = min(max_delay, base * factor**attempt)
+    return max(0.0, delay * (1.0 + jitter * (2.0 * random.random() - 1.0)))
+
+
+def connect_with_backoff(
+    address: tuple[str, int],
+    timeout: float = 60.0,
+    attempts: int = 3,
+    base: float = 0.3,
+    factor: float = 2.0,
+    max_delay: float = 5.0,
+    jitter: float = 0.25,
+) -> socket.socket:
+    """Dial with bounded exponential backoff + jitter.
+
+    A single-shot connect fails hard during a coordinator or peer *restart
+    window* (a supervised restart spends backoff + re-register time with the
+    port dark), so every long-lived client retries briefly before surfacing
+    the error.  Jitter decorrelates a cluster's worth of clients re-dialing
+    the same endpoint at once.  Only connect-level ``OSError`` retries;
+    anything after the socket is up (auth, protocol) is the caller's problem.
+    """
+    import time
+
+    last: OSError | None = None
+    for attempt in range(max(1, attempts)):
+        try:
+            return socket.create_connection(address, timeout=timeout)
+        except OSError as e:
+            last = e
+            if attempt >= attempts - 1:
+                break
+            time.sleep(backoff_delay(attempt, base, factor, max_delay, jitter))
+    raise ConnectionError(
+        f"could not connect to {address[0]}:{address[1]} after "
+        f"{max(1, attempts)} attempt(s): {last}") from last
+
+
 def local_ip() -> str:
     """Best-effort non-loopback IP of this host, else 127.0.0.1."""
     try:

@@ -38,6 +38,42 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 from xla_cache_bootstrap import enable_persistent_cache  # noqa: E402
 
+# Per-SESSION cache directory, not the shared repo one: this jaxlib build
+# cannot reliably round-trip some executables (conv-heavy ones at least)
+# through the persistent cache across processes started at different times —
+# reloading an entry written by a previous pytest run aborts the interpreter
+# (glibc "corrupted size vs. prev_size") or silently returns wrong aux
+# outputs, killing/poisoning the whole suite.  Within one session the reuse
+# that matters (≈40 spawned node processes loading entries their driver or
+# sibling just wrote) is exercised suite-wide and sound, so each session gets
+# a fresh subdir and stale session dirs are pruned on the next start.
+_cache_root = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    _REPO_ROOT, ".jax_cache")
+if not os.environ.get("TOS_TEST_SHARED_XLA_CACHE"):
+    import shutil
+
+
+    def _session_alive(dirname: str) -> bool:
+        try:
+            os.kill(int(dirname.split("-", 1)[1]), 0)
+        except (ValueError, ProcessLookupError):
+            return False
+        except PermissionError:  # pragma: no cover - pid exists, other uid
+            pass
+        return True
+
+    for _stale in (os.listdir(_cache_root) if os.path.isdir(_cache_root) else ()):
+        # prune only DEAD sessions' dirs: a concurrent pytest (soak run in
+        # another terminal) must not lose its live cache under it.  A dir
+        # bearing OUR pid is a pid-reuse leftover (we just started) — always
+        # stale, and adopting its entries would be the cross-session poison
+        # this whole scheme exists to avoid.
+        if _stale.startswith("session-") and (
+                _stale == f"session-{os.getpid()}" or not _session_alive(_stale)):
+            shutil.rmtree(os.path.join(_cache_root, _stale), ignore_errors=True)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        _cache_root, f"session-{os.getpid()}")
+
 enable_persistent_cache()
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -387,3 +387,42 @@ def hangs_forever(args, ctx):
     """Ignores EOF and stop signals (zombie teardown probe)."""
     while True:
         time.sleep(0.5)
+
+
+def elastic_sum_batches(args, ctx):
+    """Restartable feed consumer for the elastic-recovery tests.
+
+    Appends every consumed item to a per-(executor, incarnation) coverage
+    file (so the test can assert at-least-once delivery across a death), and
+    — when ``args['model_dir']`` is set — checkpoints a step counter after
+    every batch and resumes it via ``checkpoint.restore_for_restart`` on a
+    supervised restart, reporting the resumed step through ``update_meta``.
+    """
+    manager = None
+    step = 0
+    if args.get("model_dir"):
+        import numpy as np
+
+        from tensorflowonspark_tpu import checkpoint as tckpt
+
+        model_dir = os.path.join(args["model_dir"], f"node_{ctx.executor_id}")
+        manager = tckpt.CheckpointManager(model_dir, max_to_keep=2,
+                                          async_save=False)
+        restored = tckpt.restore_for_restart(ctx, manager)
+        if restored is not None:
+            step = int(restored[1])
+    ctx.update_meta({"incarnation": ctx.incarnation,
+                     f"resumed_step_inc{ctx.incarnation}": step})
+    cover = os.path.join(
+        args["out_dir"], f"seen_{ctx.executor_id}_inc{ctx.incarnation}.txt")
+    feed = ctx.get_data_feed(train_mode=True)
+    with open(cover, "a") as f:
+        while not feed.should_stop():
+            batch = feed.next_batch(args["batch_size"])
+            if not batch:
+                continue
+            f.write("".join(f"{int(x)}\n" for x in batch))
+            f.flush()
+            step += 1
+            if manager is not None:
+                manager.save(step, {"step": np.asarray(step)})

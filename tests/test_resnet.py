@@ -59,7 +59,21 @@ def test_resnet50_registry_builds():
     assert model.num_classes == 10
 
 
-def test_train_step_descends_loss_fsdp_mesh():
+@pytest.fixture
+def no_persistent_cache():
+    """This jaxlib build cannot round-trip the bn-train-step executables
+    through the persistent compilation cache: reloading the fsdp variant
+    corrupts the heap (glibc "corrupted size vs. prev_size" abort that kills
+    the whole pytest process), and reloading the dp variant silently returns
+    zeroed batch_stats aux outputs.  Cold compiles are correct, so these two
+    tests opt out of the cache and pay the ~30s compile every run."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_train_step_descends_loss_fsdp_mesh(no_persistent_cache):
     mesh = meshlib.make_mesh(dp=-1, fsdp=2)
     model = tiny_resnet()
     optimizer = optax.sgd(0.05, momentum=0.9)
@@ -75,7 +89,7 @@ def test_train_step_descends_loss_fsdp_mesh():
     assert int(jax.device_get(state.step)) == 5
 
 
-def test_batch_stats_update():
+def test_batch_stats_update(no_persistent_cache):
     mesh = meshlib.make_mesh(dp=-1)
     model = tiny_resnet()
     optimizer = optax.sgd(0.05)
@@ -100,11 +114,15 @@ def test_fsdp_shardings_split_largest_divisible_dim():
 
 
 @pytest.mark.dryrun
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     """The driver's multichip gate runs this same entry point directly every
     round — the ONE test whose coverage is independently re-executed outside
     the suite.  Opt-in (`-m dryrun`, ~90s: six full SPMD train-step compiles)
-    so the default gate can afford to include every other slow test."""
+    so the default gate can afford to include every other slow test.  Also
+    marked ``slow``: a bare ``-m 'not slow'`` on the command line REPLACES the
+    addopts marker filter, and this duplicate of the driver's own gate should
+    not ride back in through that door."""
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
