@@ -1,0 +1,473 @@
+"""The toslint checkers — this codebase's invariants, mechanically enforced.
+
+Five disciplines, each born from a class of bug the elastic control/data
+plane makes likely (see ISSUE 2 / ROADMAP):
+
+- ``knob-discipline``: every ``TOS_*`` env read goes through
+  ``utils/envtune`` and is registered in ``utils/knobs.py`` (which the
+  README table mirrors) — an undocumented knob is untunable in production.
+- ``dial-discipline``: no raw ``socket.create_connection`` outside
+  ``utils/net.py`` — a single-shot dial turns every restart window into a
+  hard failure; ``connect_with_backoff`` is the one sanctioned dial.
+- ``lock-discipline``: in the threaded modules, attributes mutated both
+  under and outside ``self._lock`` (a data race until proven otherwise),
+  and blocking calls made while a lock is held (a convoy/deadlock seed).
+- ``silent-except``: ``except ...: pass`` without a log line or an explicit
+  ``# toslint: allow-silent(<reason>)`` pragma — silence is how invariants
+  rot.
+- ``trace-purity``: no wall-clock reads, ``np.random``, ``os.environ`` or
+  global/nonlocal mutation inside ``jax.jit``/``pjit``/``shard_map``-traced
+  functions — tracing bakes the first value in forever.
+
+All heuristics are lexical and intra-file by design: cheap enough for
+tier-1, no imports of the checked code, false positives go to the committed
+baseline (except the two never-baselined classes, which are always fixed).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterator
+
+from tensorflowonspark_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleSource,
+    register_checker,
+)
+
+
+def _scoped_walk(node: ast.AST, scope: tuple[str, ...] = ()):
+    """Yield (node, enclosing-scope tuple); scope nodes include themselves."""
+    for child in ast.iter_child_nodes(node):
+        child_scope = scope
+        if isinstance(child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
+            child_scope = scope + (child.name,)
+        yield child, child_scope
+        yield from _scoped_walk(child, child_scope)
+
+
+def _qual(scope: tuple[str, ...]) -> str:
+    return ".".join(scope) or "<module>"
+
+
+def _terminal_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _module_consts(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` string constants (so the common
+    ``ENV_VAR = "TOS_X"`` indirection stays visible to the env checkers)."""
+    out: dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _literal_str(node: ast.AST | None, consts: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+# -- 1. knob discipline -------------------------------------------------------
+
+_ENV_READ_QUALS = frozenset({
+    "os.environ.get", "os.getenv", "os.environ.setdefault", "os.environ.pop",
+})
+_ENV_HELPERS = frozenset({"env_float", "env_int", "env_str", "env_bool"})
+
+
+@register_checker
+class KnobDisciplineChecker(Checker):
+    """TOS_* env reads must go through utils/envtune + the knob registry."""
+
+    id = "knob-discipline"
+    hint = ("read the knob via utils/envtune (env_float/env_int/env_str/"
+            "env_bool) and register it in utils/knobs.py")
+
+    def __init__(self) -> None:
+        self._used: set[str] = set()
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        exempt = mod.path.endswith("utils/envtune.py")
+        consts = _module_consts(mod.tree)
+        from tensorflowonspark_tpu.utils import knobs
+
+        for node, scope in _scoped_walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fq = mod.imports.qualify(node.func)
+                # alias-resolved terminal name: `env_float as _env_float`
+                # still counts as the helper it is
+                name = (fq.rsplit(".", 1)[-1] if fq
+                        else _terminal_name(node.func))
+                if fq in _ENV_READ_QUALS and not exempt:
+                    knob = _literal_str(node.args[0] if node.args else None, consts)
+                    if knob and knob.startswith("TOS_"):
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"raw env read of {knob} (via {fq}) bypasses utils/envtune",
+                            self.hint, f"{_qual(scope)}@{knob}")
+                elif name in _ENV_HELPERS:
+                    knob = _literal_str(node.args[0] if node.args else None, consts)
+                    if knob is None:
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"{name}() knob name is not a resolvable string "
+                            "literal; static cross-checks cannot see it",
+                            "pass the TOS_* name as a literal (or module "
+                            "constant)", f"{_qual(scope)}@<dynamic>")
+                    elif knob.startswith("TOS_"):
+                        self._used.add(knob)
+                        if knob not in knobs.KNOBS:
+                            yield Finding(
+                                self.id, mod.path, node.lineno,
+                                f"knob {knob} is read but not registered in "
+                                "utils/knobs.py",
+                                "add a Knob(name, kind, default, doc) entry "
+                                "and regenerate the README table",
+                                f"{_qual(scope)}@{knob}")
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load) and not exempt):
+                if mod.imports.qualify(node.value) == "os.environ":
+                    knob = _literal_str(node.slice, consts)
+                    if knob and knob.startswith("TOS_"):
+                        yield Finding(
+                            self.id, mod.path, node.lineno,
+                            f"raw env read of {knob} (os.environ[...]) "
+                            "bypasses utils/envtune",
+                            self.hint, f"{_qual(scope)}@{knob}")
+
+    def finalize(self, project_root: Path | None) -> Iterator[Finding]:
+        from tensorflowonspark_tpu.utils import knobs
+
+        for name in sorted(set(knobs.KNOBS) - self._used):
+            yield Finding(
+                self.id, "tensorflowonspark_tpu/utils/knobs.py", 1,
+                f"registered knob {name} is never read through utils/envtune",
+                "delete the stale registry entry or wire the read through "
+                "envtune", f"<registry>@{name}")
+        readme = None if project_root is None else project_root / "README.md"
+        if readme is None or not readme.exists():
+            return
+        lines = readme.read_text(encoding="utf-8").splitlines()
+        span = knobs.find_table_block(lines)
+        if span is None:
+            yield Finding(
+                self.id, "README.md", 1,
+                "README has no generated knob table "
+                f"({knobs.TABLE_BEGIN.split(' ')[0]}... markers missing)",
+                "run `python -m tensorflowonspark_tpu.analysis "
+                "--write-knob-table`", "<readme>@knob-table")
+            return
+        begin, end = span
+        block = "\n".join(lines[begin + 1:end]).strip()
+        if block != knobs.knob_table_markdown().strip():
+            yield Finding(
+                self.id, "README.md", begin + 1,
+                "README knob table is out of sync with utils/knobs.py",
+                "run `python -m tensorflowonspark_tpu.analysis "
+                "--write-knob-table`", "<readme>@knob-table")
+
+
+# -- 2. dial discipline -------------------------------------------------------
+
+
+@register_checker
+class DialDisciplineChecker(Checker):
+    """Raw socket dials are forbidden outside utils/net.py."""
+
+    id = "dial-discipline"
+    hint = ("dial via utils.net.connect_with_backoff (bounded retries + "
+            "jitter); a one-shot connect fails hard across restart windows")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if mod.path.endswith("utils/net.py"):
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and mod.imports.qualify(node.func) == "socket.create_connection"):
+                yield Finding(
+                    self.id, mod.path, node.lineno,
+                    "raw socket.create_connection bypasses connect_with_backoff",
+                    self.hint, f"{_qual(scope)}@create_connection")
+
+
+# -- 3. lock discipline / race heuristics ------------------------------------
+
+_THREADED_BASENAMES = frozenset({
+    "coordinator.py", "cluster.py", "dataserver.py", "supervisor.py",
+    "node.py", "feeding.py",
+})
+_BLOCKING_NAMES = frozenset({
+    "recv", "accept", "join", "sleep", "connect_with_backoff",
+    # this tree's blocking socket-I/O wrappers (dataserver/coordinator frame
+    # helpers + utils.net.recv_exact) — without these the checker would be
+    # blind to blocking-under-lock written the idiomatic way here
+    "_send", "_recv", "_send_msg", "_recv_msg", "recv_exact",
+})
+# join() on paths/strings is not the thread join this checker hunts
+_SAFE_JOIN_QUALS = frozenset({
+    "os.path.join", "posixpath.join", "ntpath.join",
+    "os.pathsep.join", "os.sep.join", "os.linesep.join",
+})
+_LOCKISH_FRAGMENTS = ("lock", "cond", "mutex")
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _terminal_name(expr)
+    return bool(name) and any(s in name.lower() for s in _LOCKISH_FRAGMENTS)
+
+
+@register_checker
+class LockDisciplineChecker(Checker):
+    """Race heuristics for the threaded modules: attributes mutated both
+    under and outside the instance lock, and blocking calls under a lock."""
+
+    id = "lock-discipline"
+    hint = ("hold the lock for every mutation of shared attributes, and "
+            "move blocking calls (I/O, sleeps, joins) outside the critical "
+            "section")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if mod.basename not in _THREADED_BASENAMES:
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node, scope)
+
+    def _check_class(self, mod: ModuleSource, cls: ast.ClassDef,
+                     scope: tuple[str, ...]) -> Iterator[Finding]:
+        # attr -> list of (locked, line, method)
+        mutations: dict[str, list[tuple[bool, int, str]]] = {}
+        blocking: list[tuple[str, int, str]] = []  # (call name, line, method)
+
+        def scan(node: ast.AST, locked: bool, method: str) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = locked or any(_is_lockish(i.context_expr) for i in node.items)
+                for item in node.items:
+                    scan(item, locked, method)
+                for stmt in node.body:
+                    scan(stmt, inner, method)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # a closure runs later, not while this frame holds the lock
+                body = node.body if isinstance(node.body, list) else [node.body]
+                for stmt in body:
+                    scan(stmt, False, method)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)) and not (
+                    isinstance(node, ast.AnnAssign) and node.value is None):
+                # a bare `self.x: T` annotation writes nothing
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    for attr in self._self_attrs(t):
+                        mutations.setdefault(attr, []).append(
+                            (locked, node.lineno, method))
+            if isinstance(node, ast.Call) and locked:
+                name = _terminal_name(node.func)
+                if name in _BLOCKING_NAMES and not self._safe_join(mod, node):
+                    blocking.append((name, node.lineno, method))
+            for child in ast.iter_child_nodes(node):
+                scan(child, locked, method)
+
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if item.name == "__init__":
+                    continue  # construction happens-before publication
+                # `*_locked` suffix is this codebase's caller-holds-the-lock
+                # contract: the body runs inside the caller's critical
+                # section (so its mutations ARE locked, and blocking calls
+                # in it ARE blocking-under-lock)
+                held = item.name.endswith("_locked")
+                for stmt in item.body:
+                    scan(stmt, held, item.name)
+
+        qual = _qual(scope)
+        for name, line, method in blocking:
+            yield Finding(
+                self.id, mod.path, line,
+                f"blocking call {name}() while holding a lock "
+                f"(in {qual}.{method})",
+                self.hint, f"{qual}.{method}@block:{name}")
+        for attr, sites in sorted(mutations.items()):
+            locked_methods = sorted({m for locked, _, m in sites if locked})
+            if not locked_methods:
+                continue
+            for locked, line, method in sites:
+                if locked:
+                    continue
+                yield Finding(
+                    self.id, mod.path, line,
+                    f"self.{attr} is mutated under the lock elsewhere "
+                    f"(e.g. {qual}.{locked_methods[0]}) but without it in "
+                    f"{qual}.{method} — racy unless externally serialized",
+                    self.hint, f"{qual}.{method}@mixed:{attr}")
+
+    @staticmethod
+    def _self_attrs(target: ast.AST) -> list[str]:
+        """Attribute names a target mutates on ``self`` (including
+        ``self.x[...] = ...`` container writes)."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return [a for t in target.elts for a in LockDisciplineChecker._self_attrs(t)]
+        if isinstance(target, ast.Starred):
+            return LockDisciplineChecker._self_attrs(target.value)
+        node = target
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return [node.attr]
+        return []
+
+    @staticmethod
+    def _safe_join(mod: ModuleSource, call: ast.Call) -> bool:
+        func = call.func
+        if not isinstance(func, ast.Attribute) or func.attr != "join":
+            return False
+        if isinstance(func.value, ast.Constant):  # "".join / b"".join
+            return True
+        return mod.imports.qualify(func) in _SAFE_JOIN_QUALS
+
+
+# -- 4. silent-exception discipline ------------------------------------------
+
+
+@register_checker
+class SilentExceptChecker(Checker):
+    """``except ...: pass`` needs a log line or an allow-silent pragma."""
+
+    id = "silent-except"
+    hint = ("log the swallow (logger.debug at least, with exc_info where "
+            "useful) or annotate `# toslint: allow-silent(<reason>)`")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node, scope in _scoped_walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(self._is_noop(stmt) for stmt in node.body):
+                continue
+            if mod.pragmas.allow_silent(node.lineno, node.body[0].lineno):
+                continue
+            exc = ast.unparse(node.type) if node.type is not None else "<bare>"
+            yield Finding(
+                self.id, mod.path, node.lineno,
+                f"`except {exc}: pass` swallows the error with no trace",
+                self.hint, f"{_qual(scope)}@except:{exc}")
+
+    @staticmethod
+    def _is_noop(stmt: ast.stmt) -> bool:
+        return isinstance(stmt, ast.Pass) or (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+
+# -- 5. trace purity ----------------------------------------------------------
+
+_JIT_NAMES = frozenset({"jit", "pjit", "shard_map"})
+_IMPURE_CALL_QUALS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "os.getenv",
+})
+
+
+def _is_jit_expr(mod: ModuleSource, expr: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``pjit`` / ``shard_map`` (bare or aliased),
+    ``jax.jit(...)`` calls, and ``partial(jax.jit, ...)``."""
+    name = _terminal_name(expr)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        if _terminal_name(expr.func) == "partial":
+            return any(_is_jit_expr(mod, a) for a in expr.args)
+        return _is_jit_expr(mod, expr.func)
+    return False
+
+
+@register_checker
+class TracePurityChecker(Checker):
+    """No wall-clock, np.random, os.environ, or global/nonlocal mutation
+    inside jit/pjit/shard_map-traced functions: tracing runs the Python body
+    ONCE, so any such value is frozen into the compiled program."""
+
+    id = "trace-purity"
+    hint = ("hoist the impure read out of the traced function and pass the "
+            "value (or a jax.random key) as an argument")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        traced: list[ast.AST] = []
+        wrapped_names: set[str] = set()
+        for node, _ in _scoped_walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_expr(mod, d) for d in node.decorator_list):
+                    traced.append(node)
+            elif isinstance(node, ast.Call) and _terminal_name(node.func) in _JIT_NAMES:
+                if node.args:
+                    if isinstance(node.args[0], ast.Name):
+                        wrapped_names.add(node.args[0].id)
+                    elif isinstance(node.args[0], ast.Lambda):
+                        traced.append(node.args[0])
+        if wrapped_names:
+            for node, _ in _scoped_walk(mod.tree):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name in wrapped_names and node not in traced):
+                    traced.append(node)
+
+        seen: set[tuple[int, str]] = set()
+        for fn in traced:
+            fn_name = getattr(fn, "name", "<lambda>")
+            for finding in self._scan_traced(mod, fn, fn_name):
+                key = (finding.line, finding.anchor)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding
+
+    def _scan_traced(self, mod: ModuleSource, fn: ast.AST,
+                     fn_name: str) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fq = mod.imports.qualify(node.func)
+                if fq in _IMPURE_CALL_QUALS:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"impure call {fq}() inside traced function "
+                        f"{fn_name!r} — the traced value is frozen at "
+                        "compile time", self.hint, f"{fn_name}@{fq}")
+                elif fq and fq.startswith("numpy.random."):
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"{fq}() inside traced function {fn_name!r} — host "
+                        "RNG state is invisible to XLA; every trace replays "
+                        "the same draw",
+                        "use jax.random with an explicit PRNGKey argument",
+                        f"{fn_name}@{fq}")
+            elif isinstance(node, ast.Attribute):
+                if mod.imports.qualify(node) == "os.environ":
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"os.environ read inside traced function {fn_name!r}"
+                        " — the trace bakes in the value at compile time",
+                        "read the env before tracing and pass the value in",
+                        f"{fn_name}@os.environ")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                names = ", ".join(node.names)
+                yield Finding(
+                    self.id, mod.path, node.lineno,
+                    f"{kind} mutation of {names} inside traced function "
+                    f"{fn_name!r} — side effects run once at trace time, "
+                    "not per step",
+                    "traced functions must be pure; return the new value "
+                    "instead", f"{fn_name}@{kind}:{names}")

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import socket
 import socketserver
 import struct
@@ -179,7 +178,9 @@ class CoordinatorServer:
             # Only an authenticated server may take a network bind from the
             # environment — TOS_COORDINATOR_HOST must never silently expose
             # an unauthenticated register/stop channel.
-            host = (os.environ.get("TOS_COORDINATOR_HOST", "")
+            from tensorflowonspark_tpu.utils.envtune import env_str
+
+            host = (env_str("TOS_COORDINATOR_HOST", "")
                     if self.authkey is not None else "127.0.0.1")
         bind_host = "" if host in ("", "0.0.0.0") else host
         self._server = Server((bind_host, 0), Handler)
@@ -636,9 +637,9 @@ class CoordinatorClient:
                 _send_msg(self._sock, {"op": "bye"})
                 try:
                     _recv_msg(self._sock)
-                except (ConnectionError, OSError, ValueError):
+                except (ConnectionError, OSError, ValueError):  # toslint: allow-silent(best-effort bye ack; the server may already be gone)
                     pass
-        except OSError:
+        except OSError:  # toslint: allow-silent(best-effort teardown; socket close below is what matters)
             pass
         finally:
             self._sock.close()

@@ -138,7 +138,9 @@ def init_from_env(force: bool = False) -> None:
     global _PLAN
     if _PLAN is not None and not force:
         return
-    spec = os.environ.get(ENV_VAR, "")
+    from tensorflowonspark_tpu.utils.envtune import env_str
+
+    spec = env_str("TOS_FAULTINJECT", "")
     if not spec:
         _PLAN = None
         return

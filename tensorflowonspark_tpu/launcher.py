@@ -175,7 +175,7 @@ class PopenHandle:
     def join(self, timeout: float | None = None) -> None:
         try:
             self.proc.wait(timeout)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired:  # toslint: allow-silent(mp.Process.join contract: a timed-out join returns with the process still alive)
             pass
 
     def terminate(self) -> None:

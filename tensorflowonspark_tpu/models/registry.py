@@ -4,7 +4,10 @@ analogue used by ``checkpoint.load_bundle_cached`` and the pipeline layer)."""
 
 from __future__ import annotations
 
+import logging
 from typing import Callable
+
+logger = logging.getLogger(__name__)
 
 _REGISTRY: dict[str, Callable] = {}
 
@@ -29,7 +32,9 @@ def build(config: dict):
             try:
                 importlib.import_module(f"tensorflowonspark_tpu.models.{mod}")
             except ImportError:
-                pass
+                # a family with a missing optional dep stays unregistered;
+                # the KeyError below lists what IS available
+                logger.debug("model family %s unavailable", mod, exc_info=True)
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; registered: {sorted(_REGISTRY)}")
     return _REGISTRY[name](config)

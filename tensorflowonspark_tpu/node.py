@@ -220,7 +220,7 @@ class NodeContext:
         if self._cons_client is not None:
             try:
                 self._cons_client._sock.close()
-            except OSError:
+            except OSError:  # toslint: allow-silent(best-effort close of an already-abandoned socket)
                 pass
             self._cons_client = None
         self._cons_pending = False
@@ -380,7 +380,8 @@ def node_main(config: NodeConfig) -> int:
             try:
                 client.report_error(executor_id, msg)
             except Exception:
-                pass
+                logger.debug("could not deliver the heartbeat-channel "
+                             "failure report either", exc_info=True)
             _enter_stop_state()
             return
         failures = 0
@@ -499,7 +500,10 @@ def node_main(config: NodeConfig) -> int:
         try:
             client.report_error(executor_id, tb)
         except Exception:
-            pass
+            # the error still reaches the driver: the silent heartbeat
+            # (no deregister follows a failed report) flags this node dead
+            logger.debug("could not report map_fun failure to the "
+                         "coordinator", exc_info=True)
         exit_code = 1
     finally:
         ctx.stop_requested.set()
@@ -512,6 +516,7 @@ def node_main(config: NodeConfig) -> int:
             # its monitor never mistakes the exit for a death.
             client.deregister(executor_id)
         except Exception:
-            pass
+            logger.debug("deregister failed during teardown (driver may "
+                         "flag this exit as a death)", exc_info=True)
         client.close()
     return exit_code

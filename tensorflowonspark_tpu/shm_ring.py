@@ -218,5 +218,5 @@ class ShmRing:
     def __del__(self):  # best-effort; explicit detach preferred
         try:
             self.detach()
-        except Exception:
+        except Exception:  # toslint: allow-silent(__del__ at interpreter teardown: the lib handle may be gone and logging is unsafe here)
             pass

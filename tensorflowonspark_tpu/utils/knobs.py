@@ -1,0 +1,113 @@
+"""Central registry of every ``TOS_*`` tuning knob.
+
+One row per knob: name, type, documented default, and a one-line operator
+docstring.  This is the single source of truth that
+
+- ``utils/envtune`` warns against at read time (an ``env_*`` call on an
+  unregistered ``TOS_*`` name is a knob that ops cannot discover);
+- the ``knob-discipline`` checker in ``tensorflowonspark_tpu.analysis``
+  cross-checks statically: every knob read in the tree must be registered
+  here, every registered knob must be read somewhere, and the README
+  "Tuning knobs" table must match ``knob_table_markdown()`` exactly
+  (regenerate with ``python -m tensorflowonspark_tpu.analysis
+  --write-knob-table``).
+
+Defaults are *rendered* strings — some real defaults are computed (e.g.
+``TOS_DEAD_NODE_TIMEOUT``), and the registry documents what ops should
+expect, not a value the runtime reads back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "float" | "int" | "str" | "bool"
+    default: str  # rendered default, as documented to operators
+    doc: str  # one-line operator-facing description
+
+
+_ALL = (
+    Knob("TOS_CONNECT_ATTEMPTS", "int", "3",
+         "Dial attempts (with backoff + jitter) for control/data-plane "
+         "clients before a connection error surfaces."),
+    Knob("TOS_COORDINATOR_HOST", "str", "(bind all, advertise local_ip())",
+         "Interface an *authenticated* coordinator binds and advertises; "
+         "ignored without an authkey (loopback-only then)."),
+    Knob("TOS_DEAD_NODE_TIMEOUT", "float", "max(12, 6 x heartbeat_interval)",
+         "Heartbeat silence (seconds) after which the driver monitor "
+         "declares a node dead."),
+    Knob("TOS_DRAIN_STALL_TIMEOUT", "float", "300",
+         "Elastic train() tail drain: stop waiting for buffered partitions "
+         "after this long without consumption progress."),
+    Knob("TOS_EOF_TIMEOUT", "float", "20",
+         "Budget (seconds) for the teardown-path EndOfFeed round-trip to "
+         "each node."),
+    Knob("TOS_FAULTINJECT", "str", "(unset: disabled)",
+         "Deterministic chaos-hook spec (kill / drop_heartbeats / sever); "
+         "see faultinject.py for the grammar."),
+    Knob("TOS_FEED_TIMEOUT", "float", "600",
+         "How long one driver feed call may block against a node whose "
+         "consumer has stalled."),
+    Knob("TOS_FS_ROOTS", "str", "(unset: no mappings)",
+         "scheme=root remote-filesystem mappings (os.pathsep-separated) "
+         "carrying register_fs_root() into node processes."),
+    Knob("TOS_MAX_PARTITION_ATTEMPTS", "int", "3",
+         "Total feed attempts per partition (at-least-once ledger) before "
+         "the job fails."),
+    Knob("TOS_MAX_RESTARTS", "int", "2",
+         "Supervised restarts allowed per executor slot before it is "
+         "permanently failed."),
+    Knob("TOS_RECOVERY_TIMEOUT", "float", "90",
+         "How long the partition ledger waits for a dead slot to come back "
+         "before failing the job."),
+    Knob("TOS_REREGISTER_TIMEOUT", "float", "60",
+         "Window a respawned replacement gets to re-register before the "
+         "supervisor counts another death."),
+    Knob("TOS_RESERVATION_TIMEOUT", "float", "120",
+         "How long the driver waits for all nodes to register at startup."),
+    Knob("TOS_RESTART_BACKOFF_BASE", "float", "0.5",
+         "Supervised-restart backoff: delay before the first restart "
+         "(seconds)."),
+    Knob("TOS_RESTART_BACKOFF_FACTOR", "float", "2.0",
+         "Supervised-restart backoff: multiplier per successive restart."),
+    Knob("TOS_RESTART_BACKOFF_MAX", "float", "10.0",
+         "Supervised-restart backoff: cap on the per-restart delay "
+         "(seconds)."),
+    Knob("TOS_SHM_RING", "bool", "1",
+         "Same-host shared-memory ring upgrade for the data plane; set 0 "
+         "where hard kills (OOM, preemption) are expected."),
+    Knob("TOS_SHUTDOWN_TIMEOUT", "float", "120",
+         "Budget for shutdown() to join node processes before escalating "
+         "to terminate/kill."),
+)
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _ALL}
+
+# README block delimiters; knob_table_markdown() emits the table BETWEEN
+# these, and the knob-discipline checker requires the block to match.
+TABLE_BEGIN = "<!-- knob-table:begin (generated; run `python -m tensorflowonspark_tpu.analysis --write-knob-table`) -->"
+TABLE_END = "<!-- knob-table:end -->"
+
+
+def find_table_block(lines: list[str]) -> tuple[int, int] | None:
+    """(begin, end) indices of the marker lines in README lines, else None.
+    The one marker-locating implementation shared by the knob-discipline
+    checker and ``--write-knob-table`` so the two can never drift."""
+    try:
+        return lines.index(TABLE_BEGIN), lines.index(TABLE_END)
+    except ValueError:
+        return None
+
+
+def knob_table_markdown() -> str:
+    """The generated README "Tuning knobs" table body (no markers)."""
+    rows = ["| Knob | Type | Default | What it tunes |",
+            "|---|---|---|---|"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        rows.append(f"| `{k.name}` | {k.kind} | `{k.default}` | {k.doc} |")
+    return "\n".join(rows)

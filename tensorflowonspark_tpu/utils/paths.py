@@ -38,7 +38,9 @@ def register_fs_root(scheme: str, local_root: str, export: bool = True) -> None:
 
 
 def _load_env_roots() -> None:
-    for pair in os.environ.get(_ENV_KEY, "").split(os.pathsep):
+    from tensorflowonspark_tpu.utils.envtune import env_str
+
+    for pair in env_str("TOS_FS_ROOTS", "").split(os.pathsep):
         if "=" in pair:
             scheme, root = pair.split("=", 1)
             _FS_ROOTS.setdefault(scheme, root)

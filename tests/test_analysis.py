@@ -1,0 +1,618 @@
+"""Unit tests for the toslint framework and every checker.
+
+Contract per checker: at least one fixture it FIRES on and one compliant
+rewrite it stays QUIET on — so a checker that silently stops matching (an
+ast refactor, a rename) fails here, not by letting rot back in.  Plus the
+baseline round-trip (add finding -> baseline suppresses -> removing the
+entry re-fires) and CLI determinism.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from tensorflowonspark_tpu.analysis import core
+from tensorflowonspark_tpu.utils import envtune, knobs
+
+PKG = "tensorflowonspark_tpu"
+
+
+def lint(src: str, path: str, checker: str) -> list[core.Finding]:
+    return core.analyze_source(textwrap.dedent(src), path, [checker])
+
+
+# -- knob discipline ----------------------------------------------------------
+
+
+def test_knob_fires_on_raw_environ_get():
+    found = lint(
+        """
+        import os
+        def f():
+            return os.environ.get("TOS_FOO")
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert len(found) == 1 and "TOS_FOO" in found[0].message
+
+
+def test_knob_fires_on_environ_subscript_and_module_constant():
+    found = lint(
+        """
+        import os
+        KEY = "TOS_BAR"
+        def f():
+            a = os.environ["TOS_FOO"]
+            b = os.environ.get(KEY)
+            return a, b
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert {f.anchor for f in found} == {"f@TOS_FOO", "f@TOS_BAR"}
+
+
+def test_knob_quiet_on_non_tos_names_and_inside_envtune():
+    quiet = lint(
+        """
+        import os
+        def f():
+            return os.environ.get("JAX_PLATFORMS")
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert quiet == []
+    exempt = lint(
+        """
+        import os
+        def env_float(name, default):
+            return os.environ.get("TOS_WHATEVER")
+        """, f"{PKG}/utils/envtune.py", "knob-discipline")
+    assert exempt == []
+
+
+def test_knob_fires_on_unregistered_helper_read():
+    found = lint(
+        """
+        from tensorflowonspark_tpu.utils.envtune import env_float
+        x = env_float("TOS_NOT_A_REAL_KNOB", 1.0)
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert len(found) == 1 and "not registered" in found[0].message
+
+
+def test_knob_quiet_on_registered_read_even_aliased():
+    quiet = lint(
+        """
+        from tensorflowonspark_tpu.utils.envtune import env_float as _env_float
+        from tensorflowonspark_tpu.utils.envtune import env_int
+        a = _env_float("TOS_EOF_TIMEOUT", 20.0)
+        b = env_int("TOS_MAX_RESTARTS", 2, minimum=0)
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert quiet == []
+
+
+def test_knob_fires_on_dynamic_knob_name():
+    found = lint(
+        """
+        from tensorflowonspark_tpu.utils.envtune import env_float
+        def f(name):
+            return env_float(name, 1.0)
+        """, f"{PKG}/somemod.py", "knob-discipline")
+    assert len(found) == 1 and "literal" in found[0].hint
+
+
+def test_knob_registry_readme_sync(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("")
+    readme = tmp_path / "README.md"
+    # 1) markers missing entirely
+    readme.write_text("# nothing\n")
+    findings = core.run_analysis(pkg, ["knob-discipline"])
+    assert any(f.anchor == "<readme>@knob-table"
+               and "markers missing" in f.message for f in findings)
+    # 2) markers present but the table drifted
+    readme.write_text(
+        f"{knobs.TABLE_BEGIN}\n| stale |\n{knobs.TABLE_END}\n")
+    findings = core.run_analysis(pkg, ["knob-discipline"])
+    assert any(f.anchor == "<readme>@knob-table"
+               and "out of sync" in f.message for f in findings)
+    # 3) generated table in place -> quiet
+    readme.write_text(
+        f"{knobs.TABLE_BEGIN}\n{knobs.knob_table_markdown()}\n{knobs.TABLE_END}\n")
+    findings = core.run_analysis(pkg, ["knob-discipline"])
+    assert not any(f.anchor == "<readme>@knob-table" for f in findings)
+
+
+def test_knob_registry_flags_never_read_knobs(tmp_path):
+    # a tmp package that reads nothing: every registered knob is "unused"
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("")
+    findings = core.run_analysis(pkg, ["knob-discipline"])
+    unused = {f.anchor.split("@", 1)[1] for f in findings
+              if f.anchor.startswith("<registry>@")}
+    assert unused == set(knobs.KNOBS)
+
+
+# -- dial discipline ----------------------------------------------------------
+
+
+def test_dial_fires_outside_net_py():
+    found = lint(
+        """
+        import socket
+        def dial(addr):
+            return socket.create_connection(addr, timeout=5)
+        """, f"{PKG}/somemod.py", "dial-discipline")
+    assert len(found) == 1 and found[0].anchor == "dial@create_connection"
+
+
+def test_dial_quiet_inside_net_py_and_on_sanctioned_dial():
+    assert lint(
+        """
+        import socket
+        def connect_with_backoff(addr):
+            return socket.create_connection(addr)
+        """, f"{PKG}/utils/net.py", "dial-discipline") == []
+    assert lint(
+        """
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+        def dial(addr):
+            return connect_with_backoff(addr, attempts=3)
+        """, f"{PKG}/somemod.py", "dial-discipline") == []
+
+
+# -- lock discipline ----------------------------------------------------------
+
+_MIXED = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def locked_inc(self):
+        with self._lock:
+            self.n += 1
+    def unlocked_set(self):
+        self.n = 5
+"""
+
+
+def test_lock_fires_on_mixed_locked_unlocked_mutation():
+    found = lint(_MIXED, f"{PKG}/cluster.py", "lock-discipline")
+    assert len(found) == 1
+    assert found[0].anchor == "C.unlocked_set@mixed:n"
+    assert "locked_inc" in found[0].message
+
+
+def test_lock_quiet_outside_threaded_modules_and_when_all_locked():
+    assert lint(_MIXED, f"{PKG}/models/mnist.py", "lock-discipline") == []
+    assert lint(
+        """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+            def reset(self):
+                with self._lock:
+                    self.n = 0
+        """, f"{PKG}/cluster.py", "lock-discipline") == []
+
+
+def test_lock_fires_on_blocking_call_under_lock():
+    found = lint(
+        """
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """, f"{PKG}/dataserver.py", "lock-discipline")
+    assert len(found) == 1 and found[0].anchor == "C.f@block:sleep"
+
+
+def test_lock_quiet_on_blocking_call_outside_lock_and_safe_joins():
+    assert lint(
+        """
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1.0)
+        """, f"{PKG}/dataserver.py", "lock-discipline") == []
+    assert lint(
+        """
+        import os
+        class C:
+            def f(self, parts):
+                with self._lock:
+                    a = ",".join(parts)
+                    b = os.path.join("x", "y")
+                return a, b
+        """, f"{PKG}/dataserver.py", "lock-discipline") == []
+
+
+def test_lock_locked_suffix_means_caller_holds_the_lock():
+    # the `*_locked` naming contract: its mutations count as locked...
+    assert lint(
+        """
+        import threading
+        class C:
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+                    self._bump_locked()
+            def _bump_locked(self):
+                self.n += 1
+        """, f"{PKG}/cluster.py", "lock-discipline") == []
+    # ...and blocking calls in it ARE blocking-under-lock
+    found = lint(
+        """
+        import time
+        class C:
+            def _wait_locked(self):
+                time.sleep(0.5)
+        """, f"{PKG}/cluster.py", "lock-discipline")
+    assert len(found) == 1 and found[0].anchor == "C._wait_locked@block:sleep"
+
+
+def test_lock_fires_on_framing_wrapper_io_under_lock():
+    # the tree's idiomatic blocking I/O goes through _send/_recv wrappers;
+    # the checker must see those, not just bare socket method names
+    found = lint(
+        """
+        class C:
+            def call(self, msg):
+                with self._lock:
+                    _send_msg(self._sock, msg)
+                    return _recv_msg(self._sock)
+        """, f"{PKG}/coordinator.py", "lock-discipline")
+    assert {f.anchor for f in found} == {"C.call@block:_send_msg",
+                                         "C.call@block:_recv_msg"}
+
+
+def test_lock_bare_annotation_is_not_a_mutation():
+    assert lint(
+        """
+        import threading
+        class C:
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+            def h(self):
+                self.n: int
+        """, f"{PKG}/cluster.py", "lock-discipline") == []
+
+
+def test_lock_closure_bodies_do_not_inherit_the_lock():
+    assert lint(
+        """
+        import time, threading
+        class C:
+            def f(self):
+                with self._lock:
+                    def cb():
+                        time.sleep(1.0)
+                    self._cb = cb
+        """, f"{PKG}/node.py", "lock-discipline") == []
+
+
+# -- silent-except discipline -------------------------------------------------
+
+
+def test_silent_except_fires():
+    found = lint(
+        """
+        def f():
+            try:
+                risky()
+            except ValueError:
+                pass
+        """, f"{PKG}/somemod.py", "silent-except")
+    assert len(found) == 1 and found[0].anchor == "f@except:ValueError"
+
+
+def test_silent_except_quiet_with_reasoned_pragma_only():
+    assert lint(
+        """
+        def f():
+            try:
+                risky()
+            except ValueError:  # toslint: allow-silent(best-effort teardown)
+                pass
+        """, f"{PKG}/somemod.py", "silent-except") == []
+    # a reason-less pragma documents nothing and suppresses nothing
+    found = lint(
+        """
+        def f():
+            try:
+                risky()
+            except ValueError:  # toslint: allow-silent()
+                pass
+        """, f"{PKG}/somemod.py", "silent-except")
+    assert len(found) == 1
+
+
+def test_silent_except_quiet_when_logged_and_on_generic_disable():
+    assert lint(
+        """
+        def f():
+            try:
+                risky()
+            except ValueError:
+                logger.debug("risky failed", exc_info=True)
+        """, f"{PKG}/somemod.py", "silent-except") == []
+    assert lint(
+        """
+        def f():
+            try:
+                risky()
+            except ValueError:  # toslint: disable=silent-except
+                pass
+        """, f"{PKG}/somemod.py", "silent-except") == []
+
+
+# -- trace purity -------------------------------------------------------------
+
+
+def test_trace_purity_fires_on_decorated_wallclock():
+    found = lint(
+        """
+        import time
+        import jax
+        @jax.jit
+        def step(x):
+            return x * time.time()
+        """, f"{PKG}/parallel/dp.py", "trace-purity")
+    assert len(found) == 1 and found[0].anchor == "step@time.time"
+
+
+def test_trace_purity_fires_through_partial_decorator():
+    found = lint(
+        """
+        import os
+        from functools import partial
+        import jax
+        @partial(jax.jit, static_argnums=0)
+        def step(n, x):
+            return x if os.environ.get("TOS_X") else -x
+        """, f"{PKG}/ops/xent.py", "trace-purity")
+    assert any(f.anchor == "step@os.environ" for f in found)
+
+
+def test_trace_purity_fires_on_wrapped_function_and_lambda():
+    found = lint(
+        """
+        import numpy as np
+        import jax
+        def noisy(x):
+            return x + np.random.rand()
+        step = jax.jit(noisy)
+        """, f"{PKG}/models/mnist.py", "trace-purity")
+    assert len(found) == 1 and found[0].anchor == "noisy@numpy.random.rand"
+    found = lint(
+        """
+        import time
+        import jax
+        step = jax.jit(lambda x: x * time.time())
+        """, f"{PKG}/models/mnist.py", "trace-purity")
+    assert len(found) == 1 and found[0].anchor == "<lambda>@time.time"
+
+
+def test_trace_purity_fires_on_nonlocal_mutation():
+    found = lint(
+        """
+        import jax
+        def make_step():
+            count = 0
+            @jax.jit
+            def step(x):
+                nonlocal count
+                count += 1
+                return x
+            return step
+        """, f"{PKG}/parallel/dp.py", "trace-purity")
+    assert any(f.anchor == "step@nonlocal:count" for f in found)
+
+
+def test_trace_purity_quiet_on_pure_jit_and_untraced_impurity():
+    assert lint(
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(key, x):
+            return x + jax.random.normal(key, x.shape)
+        """, f"{PKG}/parallel/dp.py", "trace-purity") == []
+    assert lint(
+        """
+        import time
+        def wall():
+            return time.time()
+        """, f"{PKG}/summary.py", "trace-purity") == []
+
+
+# -- baseline round-trip + ids ------------------------------------------------
+
+_VIOLATION = """
+def f():
+    try:
+        risky()
+    except ValueError:
+        pass
+"""
+
+
+def _tmp_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(_VIOLATION))
+    return pkg
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = _tmp_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    findings = core.run_analysis(pkg, ["silent-except"])
+    assert len(findings) == 1
+    # add finding -> baseline suppresses
+    refused = core.write_baseline(bl, findings)
+    assert refused == []
+    new, suppressed, stale = core.partition_by_baseline(
+        core.run_analysis(pkg, ["silent-except"]), core.load_baseline(bl))
+    assert new == [] and len(suppressed) == 1 and stale == set()
+    # removing the baseline entry re-fires
+    bl.write_text(json.dumps({"version": 1, "findings": []}))
+    new, _, _ = core.partition_by_baseline(
+        core.run_analysis(pkg, ["silent-except"]), core.load_baseline(bl))
+    assert len(new) == 1
+
+
+def test_baseline_refuses_knob_and_dial_classes(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(
+        """
+        import os, socket
+        a = os.environ.get("TOS_RAW")
+        b = socket.create_connection(("h", 1))
+        """))
+    bl = tmp_path / "baseline.json"
+    findings = core.run_analysis(pkg, ["knob-discipline", "dial-discipline"])
+    refused = core.write_baseline(bl, findings)
+    assert {f.checker for f in refused} == {"knob-discipline", "dial-discipline"}
+    assert not any(
+        fid.startswith(("knob-discipline:", "dial-discipline:"))
+        for fid in core.load_baseline(bl))
+
+
+def test_finding_ids_are_line_free_and_duplicate_stable():
+    src = """
+    def f():
+        try:
+            a()
+        except ValueError:
+            pass
+        try:
+            b()
+        except ValueError:
+            pass
+    """
+    findings = lint(src, f"{PKG}/somemod.py", "silent-except")
+    ids = [fid for _, fid in core.finding_ids(findings)]
+    assert ids == [
+        f"silent-except:{PKG}/somemod.py:f@except:ValueError",
+        f"silent-except:{PKG}/somemod.py:f@except:ValueError#2",
+    ]
+    assert not any(str(f.line) in fid for f, fid in core.finding_ids(findings)
+                   if f.line > 3)
+
+
+def test_cli_baseline_update_is_deterministic(tmp_path):
+    from tensorflowonspark_tpu.analysis.__main__ import main
+
+    pkg = _tmp_pkg(tmp_path)
+    bl = tmp_path / "baseline.json"
+    argv = ["--package-root", str(pkg), "--baseline", str(bl),
+            "--baseline-update", "--checkers", "silent-except"]
+    assert main(argv) == 0
+    first = bl.read_bytes()
+    assert main(argv) == 0
+    assert bl.read_bytes() == first
+    assert b'"version"' in first
+    # and the gate now passes against that baseline
+    assert main(["--package-root", str(pkg), "--baseline", str(bl),
+                 "--checkers", "silent-except"]) == 0
+
+
+def test_scoped_baseline_update_preserves_other_checkers_entries(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    # the file must carry a threaded-module basename for lock-discipline
+    (pkg / "cluster.py").write_text(textwrap.dedent(
+        """
+        import time
+        class C:
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+            def g(self):
+                try:
+                    risky()
+                except ValueError:
+                    pass
+        """))
+    bl = tmp_path / "baseline.json"
+    # full update: both checkers' findings land
+    core.write_baseline(bl, core.run_analysis(
+        pkg, ["lock-discipline", "silent-except"]))
+    assert len(core.load_baseline(bl)) == 2
+    # scoped update from a silent-except-only run (which sees no lock
+    # findings) must NOT drop the lock-discipline entry
+    core.write_baseline(bl, core.run_analysis(pkg, ["silent-except"]),
+                        replace_checkers=["silent-except"])
+    kept = core.load_baseline(bl)
+    assert any(fid.startswith("lock-discipline:") for fid in kept)
+    assert any(fid.startswith("silent-except:") for fid in kept)
+    # and a scoped update DOES trim its own checker's stale entries
+    (pkg / "cluster.py").write_text("def f():\n    pass\n")
+    core.write_baseline(bl, core.run_analysis(pkg, ["silent-except"]),
+                        replace_checkers=["silent-except"])
+    kept = core.load_baseline(bl)
+    assert not any(fid.startswith("silent-except:") for fid in kept)
+    assert any(fid.startswith("lock-discipline:") for fid in kept)
+
+
+def test_unknown_checker_id_is_a_usage_error(tmp_path):
+    from tensorflowonspark_tpu.analysis.__main__ import main
+
+    assert main(["--package-root", str(_tmp_pkg(tmp_path)),
+                 "--checkers", "nope"]) == 2
+
+
+# -- envtune additions (env_str / env_bool / registry warning) ---------------
+
+
+def test_env_str_passthrough_and_default(monkeypatch):
+    monkeypatch.delenv("TOS_COORDINATOR_HOST", raising=False)
+    assert envtune.env_str("TOS_COORDINATOR_HOST", "d") == "d"
+    monkeypatch.setenv("TOS_COORDINATOR_HOST", "")
+    assert envtune.env_str("TOS_COORDINATOR_HOST", "d") == ""
+    monkeypatch.setenv("TOS_COORDINATOR_HOST", "10.0.0.1")
+    assert envtune.env_str("TOS_COORDINATOR_HOST", "d") == "10.0.0.1"
+
+
+@pytest.mark.parametrize("raw,expect", [
+    ("0", False), ("false", False), ("No", False), ("off", False),
+    ("1", True), ("true", True), ("YES", True), ("on", True),
+    ("junk", True),  # junk degrades to the default, never flips silently
+])
+def test_env_bool_values(monkeypatch, raw, expect):
+    monkeypatch.setenv("TOS_SHM_RING", raw)
+    assert envtune.env_bool("TOS_SHM_RING", True) is expect
+
+
+def test_env_bool_unset_returns_default(monkeypatch):
+    monkeypatch.delenv("TOS_SHM_RING", raising=False)
+    assert envtune.env_bool("TOS_SHM_RING", False) is False
+
+
+def test_unregistered_knob_read_warns_once(monkeypatch, caplog):
+    monkeypatch.setattr(envtune, "_unregistered_warned", set())
+    with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.utils.envtune"):
+        envtune.env_float("TOS_DEFINITELY_UNREGISTERED", 1.0)
+        envtune.env_float("TOS_DEFINITELY_UNREGISTERED", 1.0)
+    hits = [r for r in caplog.records if "not registered" in r.message]
+    assert len(hits) == 1
+    caplog.clear()
+    with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.utils.envtune"):
+        envtune.env_float("TOS_EOF_TIMEOUT", 20.0)
+    assert not [r for r in caplog.records if "not registered" in r.message]
+
+
+def test_every_registered_knob_has_doc_and_default():
+    for k in knobs.KNOBS.values():
+        assert k.doc and k.default and k.kind in {"float", "int", "str", "bool"}
+    assert knobs.knob_table_markdown().splitlines()[0].startswith("| Knob ")

@@ -1,0 +1,47 @@
+"""Tier-1 gate: toslint over the whole package — zero non-baselined findings.
+
+This is the enforcement point for the framework's coded disciplines (knob /
+dial / lock / silent-except / trace-purity, see
+``tensorflowonspark_tpu/analysis``): a PR that introduces a new violation
+fails here with the exact finding and its fix hint.  Checker unit tests
+(each checker firing AND staying quiet) live in ``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+from tensorflowonspark_tpu.analysis import core
+
+
+def _gate():
+    findings = core.run_analysis()
+    baseline = core.load_baseline(core.default_baseline_path())
+    return core.partition_by_baseline(findings, baseline)
+
+
+def test_toslint_zero_new_findings():
+    new, _suppressed, _stale = _gate()
+    assert not new, (
+        "toslint found new violations (fix them, or — for heuristic "
+        "classes only — add to analysis/baseline.json via "
+        "--baseline-update):\n" + "\n".join(core.format_finding(f) for f in new))
+
+
+def test_baseline_has_no_stale_entries():
+    # a baseline entry that no longer fires is debt that hides a future
+    # regression of the same id; --baseline-update trims it
+    _new, _suppressed, stale = _gate()
+    assert not stale, f"stale baseline entries (run --baseline-update): {sorted(stale)}"
+
+
+def test_baseline_never_grandfathers_knob_or_dial_findings():
+    # acceptance invariant: knob- and dial-discipline violations are fixed
+    # outright, never baselined
+    for fid in sorted(core.load_baseline(core.default_baseline_path())):
+        assert not fid.startswith(tuple(f"{c}:" for c in core.NEVER_BASELINE)), (
+            f"baseline grandfathers a never-baseline class: {fid}")
+
+
+def test_cli_module_exits_zero_on_clean_tree():
+    from tensorflowonspark_tpu.analysis.__main__ import main
+
+    assert main([]) == 0
