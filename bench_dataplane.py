@@ -1,0 +1,218 @@
+"""CPU-side data-plane microbench: the STREAMING fan-out table, driver-only.
+
+Regenerates the PERF_NOTES "STREAMING fan-out ceiling" numbers without a
+chip or the axon relay: N consumer processes each run a real ``DataServer``
++ ``FeedQueues`` + a draining ``DataFeed`` consumer, and the driver feeds
+them from one thread per node through real ``DataClient``s — the exact
+send/serialize/ack path ``cluster.train`` drives, minus the map_fun.
+
+Two wire configurations are compared:
+
+- ``legacy``: wire v1 frames (whole-chunk pickle blob) with a send window
+  of 1 — the request/reply ping-pong the framework shipped before the
+  zero-copy data plane (ISSUE 3).
+- ``zerocopy``: negotiated v2 frames (pickle protocol 5 out-of-band buffers,
+  ``sendmsg`` scatter-gather, ``recv_into``) with the default pipelined
+  send window.
+
+Workloads mirror PERF_NOTES round 5: 150 KB byte rows (ImageNet idiom) and
+1 KB byte rows (tabular idiom).  Rows are DISTINCT objects (pickle memoizes
+repeated objects, which would fake the legacy numbers).
+
+Usage::
+
+    python bench_dataplane.py                 # full table, markdown + JSON
+    python bench_dataplane.py --quick         # small sizes (CI smoke)
+    python bench_dataplane.py --json out.json
+
+Run on an otherwise idle box; the driver threads and the N consumers share
+the host, exactly like the same-box PERF_NOTES measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import threading
+import time
+
+
+def _consumer_main(conn, authkey: bytes, capacity: int, batch: int) -> None:
+    """Child process: one node's data plane + a drain-everything consumer."""
+    from tensorflowonspark_tpu.dataserver import DataServer
+    from tensorflowonspark_tpu.feeding import DataFeed, FeedQueues
+
+    queues = FeedQueues(capacity=capacity)
+    server = DataServer(queues, authkey, feed_timeout=120.0)
+    conn.send(server.start())
+    feed = DataFeed(queues)
+    rows = 0
+    nbytes = 0
+    while not feed.should_stop():
+        for item in feed.next_batch(batch):
+            rows += 1
+            nbytes += len(item)
+    conn.send((rows, nbytes))
+    server.stop()
+
+
+def _make_partition(rows: int, row_bytes: int, seed: int) -> list[bytes]:
+    """``rows`` DISTINCT bytes objects of ``row_bytes`` each (cheap: sliced
+    windows of one random buffer, so generation never dominates)."""
+    buf = os.urandom(row_bytes + rows)
+    return [bytes(memoryview(buf)[i:i + row_bytes]) for i in range(rows)]
+
+
+def run_fanout(num_nodes: int, *, row_bytes: int, rows_per_part: int,
+               parts_per_node: int, wire: int, send_window: int | None,
+               chunk_rows: int, capacity: int = 1024,
+               use_ring: bool = False) -> dict:
+    """One fan-out run; returns {mb_per_s, rows_per_s, seconds, ...}."""
+    from tensorflowonspark_tpu.dataserver import DataClient
+
+    authkey = b"bench"
+    ctx = mp.get_context("fork")
+    procs, conns, ports = [], [], []
+    for _ in range(num_nodes):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_consumer_main,
+                        args=(child, authkey, capacity, 256), daemon=True)
+        p.start()
+        procs.append(p)
+        conns.append(parent)
+        ports.append(parent.recv())
+
+    # pre-generate every partition so the clock measures the data plane,
+    # not os.urandom
+    parts = [[_make_partition(rows_per_part, row_bytes, seed=n * 100 + i)
+              for i in range(parts_per_node)] for n in range(num_nodes)]
+
+    # clients read TOS_SHM_RING at construction; restore it afterwards so an
+    # in-process caller (the tier-1 smoke test) doesn't leak forced-transport
+    # state into the rest of its session
+    prev_ring = os.environ.get("TOS_SHM_RING")
+    os.environ["TOS_SHM_RING"] = "1" if use_ring else "0"
+    try:
+        clients = [DataClient("127.0.0.1", port, authkey,
+                              chunk_size=chunk_rows, send_window=send_window)
+                   for port in ports]
+    finally:
+        if prev_ring is None:
+            os.environ.pop("TOS_SHM_RING", None)
+        else:
+            os.environ["TOS_SHM_RING"] = prev_ring
+    if wire == 1:
+        for c in clients:
+            c._wire = 1  # force the legacy frame format
+
+    errors: list[BaseException] = []
+
+    def _feed(i: int) -> None:
+        try:
+            for part in parts[i]:
+                clients[i].feed_partition(part)
+            clients[i].send_eof()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=_feed, args=(i,)) for i in range(num_nodes)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # the clock stops when every consumer has DRAINED its feed (end-to-end,
+    # like the cluster.train measurement), not when the last send returned
+    totals = [conn.recv() for conn in conns]
+    elapsed = time.perf_counter() - t0
+    for c in clients:
+        c.close()
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if errors:
+        raise errors[0]
+    total_rows = sum(t[0] for t in totals)
+    total_bytes = sum(t[1] for t in totals)
+    expect = num_nodes * parts_per_node * rows_per_part
+    if total_rows != expect:
+        raise RuntimeError(f"row loss: consumed {total_rows}, fed {expect}")
+    return {
+        "num_nodes": num_nodes,
+        "row_bytes": row_bytes,
+        "wire": wire,
+        "send_window": send_window,
+        "seconds": round(elapsed, 4),
+        "mb_per_s": round(total_bytes / elapsed / 1e6, 1),
+        "rows_per_s": round(total_rows / elapsed, 1),
+    }
+
+
+def bench(quick: bool = False, fanout=(1, 2, 4), repeats: int = 3) -> dict:
+    """Full table; each cell is the BEST of ``repeats`` runs (throughput
+    benches on shared boxes take the max — the slower runs measure the
+    neighbors, not the code)."""
+    image = dict(row_bytes=150_000,
+                 rows_per_part=16 if quick else 64,
+                 parts_per_node=2 if quick else 6,
+                 chunk_rows=64)
+    tabular = dict(row_bytes=1_000,
+                   rows_per_part=512 if quick else 4096,
+                   parts_per_node=2 if quick else 4,
+                   chunk_rows=512)
+    repeats = 1 if quick else max(1, repeats)
+    results: dict = {"image_150KB": {}, "tabular_1KB": {}}
+    for name, wl in (("image_150KB", image), ("tabular_1KB", tabular)):
+        key = "mb_per_s" if name.startswith("image") else "rows_per_s"
+        for label, wire, window in (("legacy_v1_pingpong", 1, 1),
+                                    ("zerocopy_v2_pipelined", 2, None)):
+            results[name][label] = [
+                max((run_fanout(n, wire=wire, send_window=window, **wl)
+                     for _ in range(repeats)), key=lambda r: r[key])
+                for n in fanout
+            ]
+    return results
+
+
+def markdown_table(results: dict) -> str:
+    lines = []
+    for name, by_mode in results.items():
+        metric = "MB/s" if name.startswith("image") else "rows/s"
+        key = "mb_per_s" if name.startswith("image") else "rows_per_s"
+        ns = [r["num_nodes"] for r in next(iter(by_mode.values()))]
+        lines.append(f"### {name} ({metric}, aggregate)")
+        lines.append("| wire | " + " | ".join(f"N={n}" for n in ns) + " |")
+        lines.append("|---|" + "---|" * len(ns))
+        for label, runs in by_mode.items():
+            vals = " | ".join(f"{r[key]:,.0f}" for r in runs)
+            lines.append(f"| {label} | {vals} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes (smoke test, noisy numbers)")
+    ap.add_argument("--fanout", default="1,2,4",
+                    help="comma-separated node counts (default 1,2,4)")
+    ap.add_argument("--json", default="",
+                    help="also write the raw results to this JSON file")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="runs per cell; the best is reported (default 3)")
+    args = ap.parse_args(argv)
+    fanout = tuple(int(x) for x in args.fanout.split(",") if x)
+    results = bench(quick=args.quick, fanout=fanout, repeats=args.repeats)
+    print(markdown_table(results))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"raw results -> {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

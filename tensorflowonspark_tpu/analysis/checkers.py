@@ -184,9 +184,19 @@ class KnobDisciplineChecker(Checker):
 # -- 2. dial discipline -------------------------------------------------------
 
 
+# The zero-copy socket primitives are easy to get subtly wrong (short
+# writes, IOV_MAX, partial recv_into) — they live behind utils/net.py
+# helpers (sendmsg_all / recv_exact_into) and the framing layer in
+# dataserver.py, and NOWHERE else.
+_ZEROCOPY_IO_NAMES = frozenset({"sendmsg", "recv_into"})
+_ZEROCOPY_IO_ALLOWED = ("utils/net.py", "dataserver.py")
+
+
 @register_checker
 class DialDisciplineChecker(Checker):
-    """Raw socket dials are forbidden outside utils/net.py."""
+    """Raw socket dials are forbidden outside utils/net.py; raw zero-copy
+    socket I/O (sendmsg/recv_into) is confined to utils/net.py +
+    dataserver.py."""
 
     id = "dial-discipline"
     hint = ("dial via utils.net.connect_with_backoff (bounded retries + "
@@ -195,13 +205,27 @@ class DialDisciplineChecker(Checker):
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         if mod.path.endswith("utils/net.py"):
             return
+        io_exempt = mod.path.endswith(_ZEROCOPY_IO_ALLOWED)
         for node, scope in _scoped_walk(mod.tree):
-            if (isinstance(node, ast.Call)
-                    and mod.imports.qualify(node.func) == "socket.create_connection"):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.imports.qualify(node.func) == "socket.create_connection":
                 yield Finding(
                     self.id, mod.path, node.lineno,
                     "raw socket.create_connection bypasses connect_with_backoff",
                     self.hint, f"{_qual(scope)}@create_connection")
+            elif not io_exempt:
+                name = _terminal_name(node.func)
+                if name in _ZEROCOPY_IO_NAMES:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"raw {name}() outside utils/net.py/dataserver.py — "
+                        "scatter-gather/preallocated-buffer socket I/O must "
+                        "go through the shared helpers (short writes, "
+                        "IOV_MAX, partial reads are handled there once)",
+                        "use utils.net.sendmsg_all / recv_exact_into (or the "
+                        "dataserver framing layer)",
+                        f"{_qual(scope)}@{name}")
 
 
 # -- 3. lock discipline / race heuristics ------------------------------------

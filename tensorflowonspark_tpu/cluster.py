@@ -317,6 +317,11 @@ class TPUCluster:
             self.supervisor = Supervisor(coordinator, launcher, policy)
         self._recovery_timeout = _env_float("TOS_RECOVERY_TIMEOUT", 90.0)
         self._max_feed_attempts = _env_int("TOS_MAX_PARTITION_ATTEMPTS", 3)
+        # Feed pump: one sender per node connection (the train/inference
+        # worker threads), chunk sends pipelined per connection
+        # (TOS_SEND_WINDOW in DataClient) and optionally capped fleet-wide
+        # (TOS_SENDER_POOL); the gate is installed on every cached client.
+        self._sender_gate = self._make_sender_gate()
         self._monitor_stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="dead-node-monitor")
@@ -394,6 +399,26 @@ class TPUCluster:
 
     # -- data-plane connections ---------------------------------------------
 
+    def _make_sender_gate(self) -> Callable[[], Any]:
+        """Send-permit factory for the feed pump (``TOS_SENDER_POOL``):
+        0/unset means every node connection sends concurrently (one sender
+        thread each); N > 0 bounds how many are mid-send at once.  The
+        permit is acquired by ``DataClient`` around individual CHUNK sends
+        — never across a whole partition round-trip, where one stalled
+        node's backpressure (or a node's inference compute) would pin a
+        permit and starve every other connection."""
+        pool = _env_int("TOS_SENDER_POOL", 0, minimum=0)
+        if pool <= 0:
+            return contextlib.nullcontext
+        sem = threading.BoundedSemaphore(pool)
+
+        @contextlib.contextmanager
+        def _permit():
+            with sem:
+                yield
+
+        return _permit
+
     def _fresh_meta(self, executor_id: int) -> dict:
         """Current node meta from the coordinator, not the formation-time
         snapshot: a supervised restart re-registered this slot with a NEW
@@ -423,6 +448,7 @@ class TPUCluster:
                 stall_timeout=self.feed_timeout,
                 connect_timeout=connect_timeout,
                 connect_attempts=connect_attempts)
+            client.sender_gate = self._sender_gate
             self._clients[executor_id] = client
         return client
 

@@ -270,6 +270,31 @@ def read_shard_columns(path: str, schema: Schema,
     return columns, counts
 
 
+def rows_to_columns(rows: list) -> tuple[tuple, list] | None:
+    """Reshape a chunk of row-dicts into ``(keys, per-key value lists)``.
+
+    The columnar half of the zero-copy wire format (``data.pack_chunk``):
+    a chunk of homogeneous row-dicts — the shape every ``dfutil`` reader
+    and the pipeline layer produce — serializes as one header + per-column
+    contiguous buffers instead of K dict pickles.  Returns None when the
+    rows do not share one key set (heterogeneous chunks stay row-major).
+    """
+    if not rows or not isinstance(rows[0], dict):
+        return None
+    keys = tuple(rows[0])
+    keyset = set(keys)
+    for r in rows:
+        if type(r) is not dict or len(r) != len(keys) or set(r) != keyset:
+            return None
+    return keys, [[r[k] for r in rows] for k in keys]
+
+
+def columns_to_rows(keys: tuple, value_lists: list) -> list[dict]:
+    """Inverse of ``rows_to_columns`` (kept here so the two can never
+    drift; ``data.PackedChunk.rows`` is the wire-side consumer)."""
+    return [dict(zip(keys, vals)) for vals in zip(*value_lists)]
+
+
 def load_tfrecords(input_dir: str, binary_features: set | None = None) -> tuple[PartitionedDataset, Schema | None]:
     """Load a TFRecord directory as a PartitionedDataset of rows (reference
     ``loadTFRecords``, ``dfutil.py:~60-100``); one partition per shard file."""

@@ -167,12 +167,18 @@ class DataFeed:
         batch: list = []
         while len(batch) < batch_size:
             try:
-                item = q.get(timeout=self.poll_interval)
+                # fast path: drain already-buffered items without the timed
+                # get's condition-wait machinery — at zero-copy feed rates
+                # the queue is rarely empty and the per-item overhead shows
+                item = q.get_nowait()
             except queue.Empty:
                 if self.stop_event is not None and self.stop_event.is_set():
                     self.done_feeding = True
                     break
-                continue
+                try:
+                    item = q.get(timeout=self.poll_interval)
+                except queue.Empty:
+                    continue
             if isinstance(item, EndPartition):
                 # the marker is FIFO-last for its partition: popping it means
                 # every item of that partition left the queue

@@ -166,9 +166,21 @@ def bundle_inference_loop(args, ctx) -> None:
         n = len(items)
         padded = list(items) + [items[-1]] * (batch_size - n)
         x = rows_to_features(padded, input_mapping)
-        preds = np.asarray(apply_fn(variables, x))[:n]
-        if postprocess == "argmax":
-            results = [int(p) for p in preds.argmax(axis=-1)]
+        out = apply_fn(variables, x)
+        if isinstance(out, dict):
+            # multi-output model: one {output name -> row value} dict per
+            # item, so output_mapping (pipeline.merge_prediction_rows) can
+            # route each named output to its own column
+            if postprocess == "argmax":
+                raise ValueError("postprocess='argmax' needs a single-output "
+                                 "model; this bundle emits named outputs "
+                                 f"{sorted(out)}")
+            cols = {k: np.asarray(v)[:n] for k, v in out.items()}
+            results = [{k: v[i] for k, v in cols.items()} for i in range(n)]
         else:
-            results = list(preds)
+            preds = np.asarray(out)[:n]
+            if postprocess == "argmax":
+                results = [int(p) for p in preds.argmax(axis=-1)]
+            else:
+                results = list(preds)
         feed.batch_results(results)

@@ -130,9 +130,17 @@ void* tos_ring_open(const char* name, uint64_t capacity, int creat) {
   return r;
 }
 
-// Push one record.  1 = ok, 0 = timeout, -1 = ring closed, -2 = too large.
-int tos_ring_push(void* h, const uint8_t* data, uint64_t len, int timeout_ms) {
+// Push one record assembled from TWO buffers (frame flag + payload) without
+// requiring the caller to join them first — the zero-copy batched push path:
+// Python hands the flag byte and the payload view separately and the only
+// copy is the memcpy into the ring itself.  This is THE ring-commit
+// implementation; the single-buffer push delegates here so the
+// wait/backoff/closed/timeout protocol exists exactly once.
+// 1 = ok, 0 = timeout, -1 = ring closed, -2 = too large.
+int tos_ring_push2(void* h, const uint8_t* a, uint64_t alen,
+                   const uint8_t* b, uint64_t blen, int timeout_ms) {
   Ring* r = static_cast<Ring*>(h);
+  uint64_t len = alen + blen;
   uint64_t need = len + 4;
   if (need > r->hdr->capacity) return -2;
   uint64_t deadline = now_ms() + (uint64_t)timeout_ms;
@@ -148,9 +156,15 @@ int tos_ring_push(void* h, const uint8_t* data, uint64_t len, int timeout_ms) {
   uint8_t lenbuf[4] = {uint8_t(len), uint8_t(len >> 8), uint8_t(len >> 16),
                        uint8_t(len >> 24)};
   copy_in(r, head, lenbuf, 4);
-  copy_in(r, head + 4, data, len);
+  if (alen) copy_in(r, head + 4, a, alen);
+  if (blen) copy_in(r, head + 4 + alen, b, blen);
   r->hdr->head.store(head + need, std::memory_order_release);
   return 1;
+}
+
+// Push one single-buffer record.  Same return codes as push2.
+int tos_ring_push(void* h, const uint8_t* data, uint64_t len, int timeout_ms) {
+  return tos_ring_push2(h, data, len, nullptr, 0, timeout_ms);
 }
 
 // Size of the next record without consuming it.

@@ -99,8 +99,19 @@ class Params:
         if attr.startswith(("set", "get")) and len(attr) > 3:
             import re
 
+            params = self.params()
             name = re.sub(r"(?<!^)(?=[A-Z])", "_", attr[3:]).lower()
-            if name in self.params():
+            if name not in params:
+                # Acronym accessors: naive camelCase splitting turns
+                # setTFRecordDir into "t_f_record_dir" and the accessor the
+                # reference API promises raises AttributeError.  Match by
+                # underscore-insensitive normalization instead, so ANY
+                # camelization of a declared param resolves (TFRecordDir ->
+                # "tfrecorddir" == "tfrecord_dir" normalized).
+                norm = attr[3:].lower()
+                name = next((p for p in params if p.replace("_", "") == norm),
+                            name)
+            if name in params:
                 if attr.startswith("set"):
                     return lambda value: self.set(name, value)
                 return lambda: self.get(name)
@@ -445,14 +456,54 @@ class TPUModel(TPUParams):
                 raise RuntimeError(
                     f"partition {p}: {len(preds)} predictions for {len(rows)} rows "
                     "(exactly-count invariant violated)")
-            out = []
-            for row, pred in zip(rows, preds):
-                row_out = dict(row) if isinstance(row, dict) else {}
-                for _, col in output_mapping.items():
-                    row_out[col] = np.asarray(pred)
-                out.append(row_out)
-            parts.append(out)
+            parts.append(merge_prediction_rows(rows, preds, output_mapping))
         return PartitionedDataset.from_partitions(parts)
+
+
+def merge_prediction_rows(rows: list, preds: list, output_mapping: dict) -> list:
+    """Merge per-row predictions into result rows under ``output_mapping``
+    ({model output → result column}).
+
+    Single-output models emit one array per row and the mapping's single
+    column receives it.  Multi-output models emit a dict per row
+    (``bundle_inference_loop`` slices dict apply outputs row-wise); each
+    mapped output lands in its column, and BOTH mismatch directions error
+    loudly — an output the mapping does not name would otherwise be dropped
+    silently, and a mapped name the model never produced used to get the
+    whole prediction blob copied under every column (multi-output mappings
+    silently mapped wrong before this check existed).
+    """
+    out = []
+    expected = set(output_mapping)
+    for row, pred in zip(rows, preds):
+        row_out = dict(row) if isinstance(row, dict) else {}
+        if isinstance(pred, dict):
+            if set(pred) != expected:
+                # per ROW, not once: a conditional head that drops an output
+                # for some rows must fail with the mapping named, never a
+                # bare KeyError (or a silently ignored extra output)
+                unmapped = sorted(set(pred) - expected)
+                if unmapped:
+                    raise ValueError(
+                        f"model outputs {unmapped} are not in output_mapping "
+                        f"{sorted(output_mapping)}; map every output (or drop "
+                        "it explicitly model-side)")
+                raise ValueError(
+                    f"output_mapping names {sorted(expected - set(pred))} but "
+                    f"this row's prediction only has {sorted(pred)}")
+            for name, col in output_mapping.items():
+                row_out[col] = np.asarray(pred[name])
+        else:
+            if len(output_mapping) > 1:
+                raise ValueError(
+                    f"output_mapping has {len(output_mapping)} entries "
+                    f"({sorted(output_mapping)}) but the model emits a single "
+                    "unnamed output; multi-output mapping needs dict "
+                    "predictions (a dict-returning apply fn)")
+            for _, col in output_mapping.items():
+                row_out[col] = np.asarray(pred)
+        out.append(row_out)
+    return out
 
 
 def _is_row_data(data: PartitionedDataset) -> bool:

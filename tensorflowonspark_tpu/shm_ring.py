@@ -56,6 +56,12 @@ def _lib():
         lib.tos_ring_push.restype = ctypes.c_int
         lib.tos_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64, ctypes.c_int]
+        lib.tos_ring_push2.restype = ctypes.c_int
+        # payload arg is c_void_p (not c_char_p) so writable buffers
+        # (bytearray/memoryview) pass without a bytes() conversion copy
+        lib.tos_ring_push2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_void_p,
+                                       ctypes.c_uint64, ctypes.c_int]
         lib.tos_ring_next_size.restype = ctypes.c_int64
         lib.tos_ring_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.tos_ring_pop.restype = ctypes.c_int64
@@ -129,31 +135,75 @@ class ShmRing:
     def capacity(self) -> int:
         return _lib().tos_ring_capacity(self._h)
 
-    def _push_record(self, record: bytes, timeout: float | None) -> None:
+    def _push_record(self, flag: bytes, payload, timeout: float | None) -> None:
+        """Push [flag byte | payload] as one ring record.  ``payload`` is any
+        1-D byte buffer (bytes/bytearray/memoryview, read-only or not); the
+        native push2 assembles the record inside the ring, so there is no
+        flag-prepend join copy and no staging copy of the payload."""
         if not self._h:
             raise RingClosed("ring detached")
-        rc = _lib().tos_ring_push(self._h, record, len(record),
-                                  -1 if timeout is None else int(timeout * 1000))
+        import numpy as _np
+
+        # np.frombuffer wraps ANY contiguous buffer (including read-only
+        # memoryviews, which ctypes.from_buffer rejects) without copying and
+        # exposes its address; the array reference keeps the memory alive
+        # across the native call.
+        arr = _np.frombuffer(payload, dtype=_np.uint8)
+        rc = _lib().tos_ring_push2(
+            self._h, flag, 1, ctypes.c_void_p(arr.ctypes.data), arr.size,
+            -1 if timeout is None else int(timeout * 1000))
         if rc == 1:
             return
         if rc == 0:
             raise RingTimeout(f"push timed out after {timeout}s")
         if rc == -1:
             raise RingClosed("ring closed")
-        raise ValueError(f"record of {len(record)} bytes exceeds ring capacity")
+        raise ValueError(f"record of {arr.size + 1} bytes exceeds ring capacity")
 
-    def put_bytes(self, data: bytes, timeout: float | None = 600.0) -> None:
+    def put_bytes(self, data, timeout: float | None = 600.0) -> None:
         max_payload = self.capacity // 2  # headroom so a segment always fits
-        if len(data) <= max_payload:
-            self._push_record(self._WHOLE + data, timeout)
+        view = memoryview(data)
+        if len(view) <= max_payload:
+            self._push_record(self._WHOLE, view, timeout)
             return
-        for start in range(0, len(data), max_payload):
-            seg = data[start:start + max_payload]
-            last = start + max_payload >= len(data)
-            self._push_record((self._LAST if last else self._MORE) + seg,
-                              timeout)
+        for start in range(0, len(view), max_payload):
+            seg = view[start:start + max_payload]
+            last = start + max_payload >= len(view)
+            self._push_record(self._LAST if last else self._MORE, seg, timeout)
 
-    def _pop_record(self, timeout: float | None) -> bytes:
+    def put_buffers(self, buffers, timeout: float | None = 600.0) -> None:
+        """Batched push: several buffers become ONE logical record stream,
+        each copied straight from its own memory into the ring (no join).
+
+        This is the ring's zero-copy framing path: a whole feed chunk —
+        frame header + K row buffers — goes in as one segmented record
+        instead of one pickled blob, so the only per-byte work is the
+        memcpy into shared memory.  Same mid-stream-timeout caveat as
+        ``put_bytes``: a RingTimeout leaves partial segments in flight and
+        the ring must be abandoned.
+        """
+        views: list = []
+        for b in buffers:
+            v = memoryview(b)
+            if v.ndim != 1 or v.itemsize != 1:
+                v = v.cast("B")
+            if len(v):
+                views.append(v)
+        if not views:
+            self._push_record(self._WHOLE, b"", timeout)
+            return
+        max_payload = self.capacity // 2
+        segs: list = []
+        for v in views:
+            for start in range(0, len(v), max_payload):
+                segs.append(v[start:start + max_payload])
+        for i, seg in enumerate(segs):
+            last = i == len(segs) - 1
+            flag = (self._WHOLE if last and i == 0
+                    else self._LAST if last else self._MORE)
+            self._push_record(flag, seg, timeout)
+
+    def _pop_record(self, timeout: float | None) -> bytearray:
         if not self._h:
             raise RingClosed("ring detached")
         lib = _lib()
@@ -163,31 +213,39 @@ class ShmRing:
             raise RingClosed("ring closed and drained")
         if size == -3:
             raise RingTimeout(f"pop timed out after {timeout}s")
-        buf = ctypes.create_string_buffer(int(size))
+        # Pop straight into a WRITABLE bytearray (no staging string buffer +
+        # raw[:n] copy): downstream zero-copy unpickling hands views of this
+        # blob to numpy, and arrays received over the ring must be writable
+        # exactly like their TCP-delivered twins.
+        buf = bytearray(int(size))
+        carr = (ctypes.c_char * len(buf)).from_buffer(buf) if buf \
+            else ctypes.create_string_buffer(0)
         # next_size succeeded ⇒ the record is already available to this (the
         # only) consumer; pop non-blockingly so the two calls can't stack up
         # to 2x the requested timeout per record.
-        n = lib.tos_ring_pop(self._h, buf, int(size), 0)
+        n = lib.tos_ring_pop(self._h, carr, int(size), 0)
+        del carr  # release the exported buffer so `buf` is resizable again
         if n == -1:
             raise RingClosed("ring closed and drained")
         if n == -3:
             raise RingTimeout(f"pop timed out after {timeout}s")
         assert n == size, (n, size)
-        return buf.raw[:int(n)]
+        return buf
 
-    def get_bytes(self, timeout: float | None = 600.0) -> bytes:
+    def get_bytes(self, timeout: float | None = 600.0) -> bytearray:
+        """One logical record as a WRITABLE bytearray (segments joined)."""
         rec = self._pop_record(timeout)
-        flag, payload = rec[:1], rec[1:]
+        flag, payload = bytes(rec[:1]), rec[1:]
         if flag == self._WHOLE:
             return payload
         parts = [payload]
         while flag == self._MORE:
             rec = self._pop_record(timeout)
-            flag, payload = rec[:1], rec[1:]
+            flag, payload = bytes(rec[:1]), rec[1:]
             parts.append(payload)
         if flag != self._LAST:
             raise ValueError(f"corrupt ring stream: unexpected flag {flag!r}")
-        return b"".join(parts)
+        return bytearray(b"").join(parts)
 
     # -- pickled objects -----------------------------------------------------
 

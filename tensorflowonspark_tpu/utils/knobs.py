@@ -77,9 +77,20 @@ _ALL = (
     Knob("TOS_RESTART_BACKOFF_MAX", "float", "10.0",
          "Supervised-restart backoff: cap on the per-restart delay "
          "(seconds)."),
-    Knob("TOS_SHM_RING", "bool", "1",
-         "Same-host shared-memory ring upgrade for the data plane; set 0 "
-         "where hard kills (OOM, preemption) are expected."),
+    Knob("TOS_RING_PROBE_BYTES", "int", "65536",
+         "Payload size for the one-shot ring-vs-loopback transport probe "
+         "(cached per process; see TOS_SHM_RING)."),
+    Knob("TOS_SEND_WINDOW", "int", "4",
+         "Pipelined feed: max unacknowledged chunk frames in flight per "
+         "node connection (1 = strict request/reply ping-pong)."),
+    Knob("TOS_SENDER_POOL", "int", "0 (one sender per node)",
+         "Cap on concurrent chunk SENDS across all node connections in "
+         "train()/inference() (permit per chunk, never held across a "
+         "partition); 0 = unlimited."),
+    Knob("TOS_SHM_RING", "str", "(unset: measured probe decides)",
+         "Same-host shared-memory ring for the data plane: 1 forces it on, "
+         "0 forces TCP, unset lets a one-shot ring-vs-loopback probe pick "
+         "the faster transport."),
     Knob("TOS_SHUTDOWN_TIMEOUT", "float", "120",
          "Budget for shutdown() to join node processes before escalating "
          "to terminate/kill."),

@@ -90,3 +90,19 @@ if jax.config.jax_platforms != "cpu":
 
         clear_backends()
     jax.config.update("jax_platforms", "cpu")
+
+
+# -- tier-1 log visibility (ISSUE 3 satellite: weak #6) -----------------------
+#
+# `--durations=15` (pyproject addopts) names the slowest tests every run;
+# this hook puts the session's TOTAL wall time on its own greppable line so
+# the tier-1 log records suite cost without parsing pytest's summary bar.
+
+import time as _time  # noqa: E402
+
+_SESSION_T0 = _time.monotonic()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    terminalreporter.write_line(
+        f"tier-1 total wall time: {_time.monotonic() - _SESSION_T0:.1f}s")

@@ -426,3 +426,40 @@ def elastic_sum_batches(args, ctx):
             step += 1
             if manager is not None:
                 manager.save(step, {"step": np.asarray(step)})
+
+
+def pipelined_consensus_consumer(args, ctx):
+    """Feed consumer driving the PIPELINED end-of-data consensus by hand
+    (vote -> "train step" -> resolve), for the death-mid-vote chaos tests.
+
+    Writes its final consensus status to ``cons_<id>.txt``: "consensus" when
+    the vote resolved normally, or "aborted:<err>" when a peer's death
+    aborted the in-flight rendezvous — in which case it ALSO exercises the
+    abandoned-vote recovery path (``_cons_pending`` reset: a fresh
+    ``all_done_begin`` after an aborted pending vote must not deadlock on
+    the dedicated connection's held lock).
+    """
+    feed = ctx.get_data_feed(train_mode=True)
+    out = os.path.join(args["out_dir"], f"cons_{ctx.executor_id}.txt")
+    status = "incomplete"
+    while True:
+        batch = feed.next_batch(args["batch_size"])  # victim's kill fires here
+        dry = feed.should_stop() and not batch
+        result = ctx.all_done_begin(dry, timeout=120.0)
+        time.sleep(args.get("step_delay", 0.05))  # the overlapped "step"
+        try:
+            if result():
+                status = "consensus"
+                break
+        except RuntimeError as e:
+            status = f"aborted:{e}"
+            try:
+                # must return immediately on a fresh connection, not
+                # self-deadlock on the abandoned vote's held client lock
+                ctx.all_done_begin(True, timeout=5.0)
+                status += ";reset-ok"
+            except RuntimeError as e2:
+                status += f";reset-raised:{e2}"
+            break
+    with open(out, "w") as f:
+        f.write(status)

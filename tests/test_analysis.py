@@ -159,6 +159,26 @@ def test_dial_quiet_inside_net_py_and_on_sanctioned_dial():
         """, f"{PKG}/somemod.py", "dial-discipline") == []
 
 
+def test_dial_fires_on_raw_zerocopy_io_outside_allowed_files():
+    found = lint(
+        """
+        def pump(sock, bufs, out):
+            sock.sendmsg(bufs)
+            sock.recv_into(out)
+        """, f"{PKG}/somemod.py", "dial-discipline")
+    assert {f.anchor for f in found} == {"pump@sendmsg", "pump@recv_into"}
+
+
+def test_dial_quiet_on_zerocopy_io_in_net_and_dataserver():
+    src = """
+        def pump(sock, bufs, out):
+            sock.sendmsg(bufs)
+            sock.recv_into(out)
+        """
+    assert lint(src, f"{PKG}/utils/net.py", "dial-discipline") == []
+    assert lint(src, f"{PKG}/dataserver.py", "dial-discipline") == []
+
+
 # -- lock discipline ----------------------------------------------------------
 
 _MIXED = """

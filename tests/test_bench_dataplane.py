@@ -1,0 +1,38 @@
+"""The committed dataplane microbench must keep running (tier-1 smoke) —
+it is the driver-verifiable evidence for the zero-copy data plane's fan-out
+numbers in PERF_NOTES, so it must not rot between measurements."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_dataplane  # noqa: E402
+
+
+def test_run_fanout_smoke_counts_every_row():
+    r = bench_dataplane.run_fanout(
+        2, row_bytes=10_000, rows_per_part=32, parts_per_node=2,
+        wire=2, send_window=4, chunk_rows=8)
+    assert r["num_nodes"] == 2
+    assert r["mb_per_s"] > 0 and r["rows_per_s"] > 0
+    # run_fanout raises on row loss; reaching here means 2*2*32 rows landed
+
+
+def test_run_fanout_legacy_wire_smoke():
+    r = bench_dataplane.run_fanout(
+        1, row_bytes=1_000, rows_per_part=64, parts_per_node=2,
+        wire=1, send_window=1, chunk_rows=32)
+    assert r["wire"] == 1 and r["rows_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_bench_quick_table_renders():
+    results = bench_dataplane.bench(quick=True, fanout=(1, 2))
+    table = bench_dataplane.markdown_table(results)
+    assert "image_150KB" in table and "tabular_1KB" in table
+    assert "zerocopy_v2_pipelined" in table

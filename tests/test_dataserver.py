@@ -95,11 +95,14 @@ def test_infer_timeout_when_model_absent():
     server.stop()
 
 
-def test_ring_upgrade_engages_on_localhost():
+def test_ring_upgrade_engages_on_localhost(monkeypatch):
     from tensorflowonspark_tpu import shm_ring
 
     if not shm_ring.available():
         pytest.skip("native shm ring not buildable")
+    # TOS_SHM_RING=1 forces the ring regardless of what the transport probe
+    # measures on this box (unset means probe-decides; see utils.net)
+    monkeypatch.setenv("TOS_SHM_RING", "1")
     queues, server, client = start_pair()
     assert client.using_ring
     feed = DataFeed(queues)
@@ -124,13 +127,14 @@ def test_tcp_path_still_works_when_ring_disabled():
     server.stop()
 
 
-def test_oversized_messages_stream_through_ring():
+def test_oversized_messages_stream_through_ring(monkeypatch):
     # Chunks (and replies) larger than the ring are segmented transparently
     # in both directions; the client stays on the ring throughout.
     from tensorflowonspark_tpu import shm_ring
 
     if not shm_ring.available():
         pytest.skip("native shm ring not buildable")
+    monkeypatch.setenv("TOS_SHM_RING", "1")
     queues = FeedQueues(capacity=1024)
     server = DataServer(queues, AUTH, feed_timeout=5.0)
     port = server.start()
@@ -172,11 +176,12 @@ def test_oversized_messages_stream_through_ring():
     server2.stop()
 
 
-def test_ring_inference_roundtrip():
+def test_ring_inference_roundtrip(monkeypatch):
     from tensorflowonspark_tpu import shm_ring
 
     if not shm_ring.available():
         pytest.skip("native shm ring not buildable")
+    monkeypatch.setenv("TOS_SHM_RING", "1")
     queues, server, client = start_pair()
     assert client.using_ring
 
@@ -196,7 +201,7 @@ def test_ring_inference_roundtrip():
     server.stop()
 
 
-def test_send_eof_after_server_stop_fails_fast():
+def test_send_eof_after_server_stop_fails_fast(monkeypatch):
     """Teardown race regression: a node can stop its data plane before the
     driver's EOF arrives.  On the shm-ring transport that used to block for
     the FULL call timeout (~minutes) because nothing closed the rings before
@@ -212,6 +217,7 @@ def test_send_eof_after_server_stop_fails_fast():
         # node process exit closes them); the fast-fail contract under test
         # is specific to the ring transport.
         pytest.skip("native shm ring not buildable")
+    monkeypatch.setenv("TOS_SHM_RING", "1")
     queues, server, client = start_pair(feed_timeout=600.0)
     assert client.using_ring
     client.send_eof("input")  # healthy path works
@@ -223,3 +229,269 @@ def test_send_eof_after_server_stop_fails_fast():
         client.send_eof("input")
     assert time.monotonic() - t0 < 30.0
     client.close()
+
+
+# -- zero-copy wire format (ISSUE 3 tentpole) ---------------------------------
+
+
+def test_wire_negotiates_v2_and_packs_chunks():
+    """Current client x current server negotiate the vectorized wire and
+    round-trip packed bytes/ndarray/tuple/dict chunks bit-identically."""
+    import numpy as np
+
+    queues, server, client = start_pair()
+    assert client._wire == 2
+    feed = DataFeed(queues)
+    byte_rows = [bytes([i]) * 4096 for i in range(20)]
+    assert client.feed_partition(byte_rows) == "running"
+    assert feed.next_batch(100) == byte_rows
+    arr_rows = [np.full((4, 3), i, np.float32) for i in range(10)]
+    assert client.feed_partition(arr_rows) == "running"
+    got = feed.next_batch(100)
+    assert all(np.array_equal(a, b) and a.dtype == b.dtype
+               for a, b in zip(arr_rows, got))
+    tup_rows = [(np.arange(6, dtype=np.int64) + i, i) for i in range(10)]
+    assert client.feed_partition(tup_rows) == "running"
+    got = feed.next_batch(100)
+    assert all(np.array_equal(a[0], b[0]) and a[1] == b[1]
+               for a, b in zip(tup_rows, got))
+    dict_rows = [{"x": np.ones(3, np.float32) * i, "label": i}
+                 for i in range(10)]
+    assert client.feed_partition(dict_rows) == "running"
+    got = feed.next_batch(100)
+    assert all(np.array_equal(a["x"], b["x"]) and a["label"] == b["label"]
+               for a, b in zip(dict_rows, got))
+    client.close()
+    server.stop()
+
+
+def test_wire_v2_roundtrip_values_exact():
+    import numpy as np
+
+    queues, server, client = start_pair()
+    feed = DataFeed(queues)
+    rows = [bytes([i]) * 1000 for i in range(16)]
+    client.feed_partition(rows)
+    assert feed.next_batch(100) == rows
+    arrs = [np.full((5, 2), i, np.int64) for i in range(8)]
+    client.feed_partition(arrs)
+    got = feed.next_batch(100)
+    assert all(np.array_equal(a, b) and a.dtype == b.dtype
+               for a, b in zip(arrs, got))
+    dicts = [{"x": np.full(4, i, np.float32), "y": float(i)} for i in range(6)]
+    client.feed_partition(dicts)
+    got = feed.next_batch(100)
+    assert all(np.array_equal(a["x"], b["x"]) and a["y"] == b["y"]
+               for a, b in zip(dicts, got))
+    client.close()
+    server.stop()
+
+
+def test_old_server_negotiates_down_to_v1():
+    """A server that predates the hello op answers unknown-op; the client
+    must stay on the v1 wire and still feed correctly (auto-negotiation)."""
+    from tensorflowonspark_tpu import dataserver as ds
+
+    queues = FeedQueues(capacity=1024)
+    server = DataServer(queues, AUTH, feed_timeout=5.0)
+    orig_handle = ds.DataServer._handle
+
+    def legacy_handle(self, msg):
+        if msg[0] == "hello":  # old servers have no hello branch
+            return ("err", f"unknown op {msg[0]!r}")
+        return orig_handle(self, msg)
+
+    server._handle = legacy_handle.__get__(server)
+    port = server.start()
+    client = DataClient("127.0.0.1", port, AUTH, chunk_size=8,
+                        prefer_ring=False)
+    assert client._wire == 1
+    feed = DataFeed(queues)
+    rows = [bytes([i]) * 256 for i in range(20)]
+    assert client.feed_partition(rows) == "running"
+    assert feed.next_batch(100) == rows
+    client.close()
+    server.stop()
+
+
+def test_v1_client_against_current_server():
+    """A legacy client (plain length-framed pickle, no hello) must keep
+    working against the new server: v1 frames get v1 replies."""
+    import pickle
+    import socket
+    import struct
+
+    from tensorflowonspark_tpu.utils.net import (
+        hmac_handshake_client, recv_exact)
+
+    queues = FeedQueues(capacity=1024)
+    server = DataServer(queues, AUTH, feed_timeout=5.0)
+    port = server.start()
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    assert hmac_handshake_client(sock, AUTH)
+    LEN = struct.Struct(">Q")
+
+    def v1_call(msg):
+        data = pickle.dumps(msg, protocol=4)
+        sock.sendall(LEN.pack(len(data)) + data)
+        (n,) = LEN.unpack(recv_exact(sock, 8))
+        assert n < (1 << 62), "reply must be a v1 frame for a v1 peer"
+        return pickle.loads(recv_exact(sock, n))
+
+    assert v1_call(("feed", "input", [1, 2, 3])) == ("ok", "running")
+    reply = v1_call(("end_partition", "input", None))
+    assert reply[0] == "ok"
+    feed = DataFeed(queues)
+    assert feed.next_batch(10) == [1, 2, 3]
+    v1_call(("close",))
+    sock.close()
+    server.stop()
+
+
+def test_pipelined_window_preserves_order_and_terminating():
+    """send_window > 1 pipelines chunk frames; ordering is preserved and a
+    mid-stream 'terminating' still stops the feed fast."""
+    queues, server, client = start_pair()
+    client.send_window = 8
+    feed = DataFeed(queues)
+    items = list(range(200))
+    assert client.feed_partition(items) == "running"
+    got = feed.next_batch(500)
+    assert got == items  # in-order delivery across the pipelined window
+    feed.terminate()
+    assert client.feed_partition(range(10_000)) == "terminating"
+    client.close()
+    server.stop()
+
+
+def test_pipelined_window_one_is_strict_ping_pong():
+    queues, server, client = start_pair()
+    client.send_window = 1
+    feed = DataFeed(queues)
+    assert client.feed_partition(range(50)) == "running"
+    assert feed.next_batch(100) == list(range(50))
+    client.close()
+    server.stop()
+
+
+def test_feed_timeout_error_surfaces_through_pipeline():
+    """An err reply (server-side feed timeout) mid-burst must surface as the
+    same RuntimeError the unpipelined path raised."""
+    queues, server, client = start_pair(feed_timeout=0.3, capacity=4)
+    client.send_window = 4
+    with pytest.raises(RuntimeError, match="feed timeout"):
+        client.feed_partition(range(100))
+    client.close()
+    server.stop()
+
+
+def test_ring_forced_off_via_knob(monkeypatch):
+    monkeypatch.setenv("TOS_SHM_RING", "0")
+    queues, server, client = start_pair()
+    assert not client.using_ring
+    feed = DataFeed(queues)
+    client.feed_partition(range(10))
+    assert feed.next_batch(20) == list(range(10))
+    client.close()
+    server.stop()
+
+
+def test_ring_probe_gates_auto_selection(monkeypatch):
+    """Unset TOS_SHM_RING: the measured probe decides.  Forcing the cached
+    probe verdict both ways must flip the selected transport."""
+    from tensorflowonspark_tpu import shm_ring
+    from tensorflowonspark_tpu.utils import net as unet
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring not buildable")
+    monkeypatch.delenv("TOS_SHM_RING", raising=False)
+    monkeypatch.setattr(unet, "_ring_probe_cache", {64 * 1024: False})
+    queues, server, client = start_pair()
+    assert not client.using_ring  # probe said TCP: ring never selected
+    client.close()
+    server.stop()
+
+    monkeypatch.setattr(unet, "_ring_probe_cache", {64 * 1024: True})
+    queues2, server2, client2 = start_pair()
+    assert client2.using_ring  # probe said ring
+    feed = DataFeed(queues2)
+    client2.feed_partition([b"r" * 2048] * 10)
+    assert feed.next_batch(20) == [b"r" * 2048] * 10
+    client2.close()
+    server2.stop()
+
+
+def test_junk_shm_ring_value_degrades_to_probe(monkeypatch):
+    """A TOS_SHM_RING typo must degrade to the documented default (the
+    probe), never silently force a transport off (or on)."""
+    from tensorflowonspark_tpu import shm_ring
+    from tensorflowonspark_tpu.utils import net as unet
+
+    if not shm_ring.available():
+        pytest.skip("native shm ring not buildable")
+    monkeypatch.setenv("TOS_SHM_RING", "auto")  # junk: not a bool value
+    monkeypatch.setattr(unet, "_ring_probe_cache", {64 * 1024: True})
+    queues, server, client = start_pair()
+    assert client.using_ring  # probe (True) decided, not the junk value
+    client.close()
+    server.stop()
+
+
+def test_received_ndarrays_are_writable_on_both_transports(monkeypatch):
+    """Pickled ndarrays were always writable; the zero-copy receive path
+    must not hand user code read-only arrays — and writability must not
+    depend on which transport delivered the batch."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import shm_ring
+
+    rows = [np.full((64, 64), i, np.float32) for i in range(6)]  # >= 4KB: packed
+    configs = [("0", False)]
+    if shm_ring.available():
+        configs.append(("1", True))
+    # mixed shapes >= 4KB: pack_chunk refuses, so numpy's OWN protocol-5
+    # reduce puts these out-of-band — the plain-row receive path must be
+    # writable too (it reconstructs from views of the receive blob)
+    mixed = [np.full((64, 64), 1.0, np.float32),
+             np.full((32, 64), 2.0, np.float32)]
+    for knob, expect_ring in configs:
+        monkeypatch.setenv("TOS_SHM_RING", knob)
+        queues, server, client = start_pair()
+        assert client.using_ring == expect_ring
+        feed = DataFeed(queues)
+        for batch in (rows, mixed):
+            client.feed_partition(batch)
+            got = feed.next_batch(10)
+            for a, b in zip(batch, got):
+                assert np.array_equal(a, b)
+                assert b.flags.writeable, \
+                    f"read-only array over ring={expect_ring}"
+                b += 1.0  # in-place mutation (the map_fun normalize idiom)
+        client.close()
+        server.stop()
+
+
+def test_structured_dtype_rows_round_trip():
+    """Structured dtypes must survive the wire with field names intact —
+    they are excluded from columnar packing (dtype.str would collapse them
+    to raw void) and travel via numpy's own reduce."""
+    import numpy as np
+
+    dt = np.dtype([("a", "<f4"), ("b", "<i4")])
+    rows = [np.zeros(2048, dtype=dt) for _ in range(3)]  # >= 4KB each
+    for i, r in enumerate(rows):
+        r["a"] += i
+        r["b"] += 10 * i
+    from tensorflowonspark_tpu.data import pack_chunk
+
+    assert pack_chunk(rows) is None  # never packed
+    queues, server, client = start_pair()
+    feed = DataFeed(queues)
+    client.feed_partition(rows)
+    got = feed.next_batch(10)
+    for a, b in zip(rows, got):
+        assert b.dtype == dt
+        np.testing.assert_array_equal(a["a"], b["a"])
+        np.testing.assert_array_equal(a["b"], b["b"])
+    client.close()
+    server.stop()

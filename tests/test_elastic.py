@@ -573,3 +573,100 @@ def test_feed_failure_names_executor_and_partition(tmp_path, monkeypatch):
                              r"\(epoch 0, attempt 1/1\)"):
         cluster.train(parts, num_epochs=1)
     cluster.shutdown(timeout=60.0)
+
+
+# -- node death x pipelined consensus vote (ISSUE 3 satellite, weak #7) -------
+
+
+def test_mark_dead_aborts_pipelined_vote_and_cons_pending_resets():
+    """Deterministic interleaving of the dead-node monitor's abort with an
+    in-flight PIPELINED consensus vote: result() must raise the abort
+    promptly (never ride out the vote timeout), and — because the raise
+    skips the _cons_pending clear — the NEXT all_done_begin must recover by
+    resetting the dedicated consensus connection instead of deadlocking on
+    its held lock."""
+    from tensorflowonspark_tpu.feeding import FeedQueues
+    from tensorflowonspark_tpu.node import NodeContext
+
+    srv, clients = _fenced_pair()
+    try:
+        (c0, id0), (c1, id1) = clients
+        info = [{"executor_id": 0, "job_name": "chief"},
+                {"executor_id": 1, "job_name": "worker"}]
+        ctx0 = NodeContext(
+            executor_id=0, job_name="chief", task_index=0, num_executors=2,
+            cluster_info=info, queues=FeedQueues(),
+            config=NodeConfig(coordinator_addr=srv.address, authkey=None,
+                              map_fun=mapfuns.noop),
+            client=c0)
+        result = ctx0.all_done_begin(False, timeout=60.0)
+        assert ctx0._cons_pending
+        time.sleep(0.3)  # let the vote join the generation
+        srv.mark_dead([id1["executor_id"]], record_error=False)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="aborted"):
+            result()
+        assert time.monotonic() - t0 < 10.0  # abort, not the 60s vote timeout
+        # the raise skipped the _cons_pending clear: the abandoned vote's
+        # reply is unread and its connection lock still held
+        assert ctx0._cons_pending
+        old_cons = ctx0._cons_client
+        result2 = ctx0.all_done_begin(True, timeout=30.0)
+        assert ctx0._cons_client is not old_cons  # fresh connection, no deadlock
+        # a replacement registers into the dead slot and completes the round
+        c2 = CoordinatorClient(srv.address)
+        ident2 = c2.register({"host": "h1-replacement"},
+                             replace=id1["executor_id"])
+        c2.set_identity(ident2["executor_id"], ident2["incarnation"])
+        name = f"all_done:{c0._gen}"  # the generation ctx0's second vote used
+        peer = threading.Thread(
+            target=lambda: c2.reduce(name, True, kind="all", count=2,
+                                     timeout=30.0), daemon=True)
+        peer.start()
+        assert result2() is True
+        assert not ctx0._cons_pending
+        peer.join(10.0)
+        ctx0._reset_consensus_client()
+        c2.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_node_death_mid_pipelined_vote_unblocks_survivor(tmp_path, monkeypatch):
+    """e2e: SIGKILL one node after its 2nd batch while its peer's pipelined
+    consensus vote is in flight.  The survivor must see the monitor's abort
+    within seconds (not the 120s vote timeout), survive the abandoned-vote
+    reset, and exit; the driver must surface the death instead of hanging."""
+    monkeypatch.setenv("TOS_SHM_RING", "0")  # a SIGKILL leaves rings wedged
+    monkeypatch.setenv("TOS_DEAD_NODE_TIMEOUT", "4")
+    items = list(range(120))
+    parts = [items[i * 20:(i + 1) * 20] for i in range(6)]
+    per_node_env = [{}, {"TOS_FAULTINJECT": "kill:after_batches=2"}]
+    cluster = tcluster.run(
+        mapfuns.pipelined_consensus_consumer,
+        {"batch_size": 4, "out_dir": str(tmp_path), "step_delay": 0.05},
+        num_executors=2,
+        input_mode=tcluster.InputMode.STREAMING,
+        heartbeat_interval=0.5,
+        per_node_env=per_node_env,
+        log_dir=str(tmp_path / "logs"),
+        reservation_timeout=120.0,
+    )
+    t0 = time.monotonic()
+    raised = []
+    try:
+        cluster.train(parts, num_epochs=1)
+    except RuntimeError as e:
+        raised.append(e)
+    try:
+        cluster.shutdown(timeout=120.0)
+    except RuntimeError as e:
+        raised.append(e)
+    assert raised, "the node death was never surfaced to the driver"
+    assert time.monotonic() - t0 < 120.0  # never rode out the vote timeout
+    survivor = (tmp_path / "cons_0.txt").read_text() \
+        if (tmp_path / "cons_0.txt").exists() else \
+        (tmp_path / "cons_1.txt").read_text()
+    assert survivor.startswith("aborted:"), survivor
+    assert "reset-ok" in survivor, survivor

@@ -73,7 +73,7 @@ def _spawn_dataserver_child(authkey: bytes) -> tuple[subprocess.Popen, int]:
     return child, port
 
 
-def test_node_sigkill_mid_ring_call_raises_and_downgrades():
+def test_node_sigkill_mid_ring_call_raises_and_downgrades(monkeypatch):
     """SIGKILL the node process while a ring request is in flight: the ring's
     closed flag is never set, so the client must time out, surface 'ring
     reply lost', and route any later call over TCP."""
@@ -81,6 +81,7 @@ def test_node_sigkill_mid_ring_call_raises_and_downgrades():
 
     if not shm_ring.available():
         pytest.skip("native shm ring unavailable")
+    monkeypatch.setenv("TOS_SHM_RING", "1")  # force past the transport probe
     authkey = secrets.token_bytes(16)
     child, port = _spawn_dataserver_child(authkey)
     try:
@@ -118,13 +119,14 @@ def test_node_sigkill_mid_ring_call_raises_and_downgrades():
         child.wait(10)
 
 
-def test_ring_send_failure_downgrades_to_tcp():
+def test_ring_send_failure_downgrades_to_tcp(monkeypatch):
     """If the SEND side of the ring fails (server never saw the request) the
     client retries the same call over TCP transparently."""
     from tensorflowonspark_tpu import shm_ring
 
     if not shm_ring.available():
         pytest.skip("native shm ring unavailable")
+    monkeypatch.setenv("TOS_SHM_RING", "1")  # force past the transport probe
     authkey = secrets.token_bytes(16)
     child, port = _spawn_dataserver_child(authkey)
     try:
