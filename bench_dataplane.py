@@ -68,8 +68,44 @@ def _make_partition(rows: int, row_bytes: int, seed: int) -> list[bytes]:
 def run_fanout(num_nodes: int, *, row_bytes: int, rows_per_part: int,
                parts_per_node: int, wire: int, send_window: int | None,
                chunk_rows: int, capacity: int = 1024,
-               use_ring: bool = False) -> dict:
-    """One fan-out run; returns {mb_per_s, rows_per_s, seconds, ...}."""
+               use_ring: bool = False, metrics: bool | None = None) -> dict:
+    """One fan-out run; returns {mb_per_s, rows_per_s, seconds, ...}.
+
+    ``metrics`` pins ``TOS_METRICS`` for this run (None = leave the
+    environment alone): the registry is reset BEFORE the consumer processes
+    fork, so driver and consumers agree on the setting — the on-vs-off
+    comparison that guards the hot path against instrumentation overhead
+    (``--metrics-compare``, BENCH_r06.json).
+    """
+    from tensorflowonspark_tpu import telemetry
+
+    if metrics is None:
+        return _run_fanout(num_nodes, row_bytes=row_bytes,
+                           rows_per_part=rows_per_part,
+                           parts_per_node=parts_per_node, wire=wire,
+                           send_window=send_window, chunk_rows=chunk_rows,
+                           capacity=capacity, use_ring=use_ring)
+    prev = os.environ.get("TOS_METRICS")
+    os.environ["TOS_METRICS"] = "1" if metrics else "0"
+    telemetry.reset()
+    try:
+        return _run_fanout(num_nodes, row_bytes=row_bytes,
+                           rows_per_part=rows_per_part,
+                           parts_per_node=parts_per_node, wire=wire,
+                           send_window=send_window, chunk_rows=chunk_rows,
+                           capacity=capacity, use_ring=use_ring)
+    finally:
+        if prev is None:
+            os.environ.pop("TOS_METRICS", None)
+        else:
+            os.environ["TOS_METRICS"] = prev
+        telemetry.reset()
+
+
+def _run_fanout(num_nodes: int, *, row_bytes: int, rows_per_part: int,
+                parts_per_node: int, wire: int, send_window: int | None,
+                chunk_rows: int, capacity: int = 1024,
+                use_ring: bool = False) -> dict:
     from tensorflowonspark_tpu.dataserver import DataClient
 
     authkey = b"bench"
@@ -177,6 +213,34 @@ def bench(quick: bool = False, fanout=(1, 2, 4), repeats: int = 3) -> dict:
     return results
 
 
+def metrics_compare(quick: bool = False, num_nodes: int = 2,
+                    repeats: int = 3) -> dict:
+    """Instrumentation-overhead guard: the 150 KB-row zero-copy config run
+    with telemetry enabled vs disabled (best of ``repeats`` each).  The
+    acceptance bar is enabled staying within 3% of disabled — the data
+    plane meters every frame, so this is the config where overhead would
+    show first."""
+    # 4x the table's partition count: each leg must run long enough
+    # (~seconds) that the on-vs-off delta is signal, not scheduler noise
+    wl = dict(row_bytes=150_000,
+              rows_per_part=16 if quick else 64,
+              parts_per_node=2 if quick else 24,
+              chunk_rows=64, wire=2, send_window=None)
+    repeats = 1 if quick else max(1, repeats)
+    # INTERLEAVED off/on pairs: on a shared box the load drifts over the
+    # seconds a phase takes, and two back-to-back phases would measure the
+    # drift, not the instrumentation; paired runs see the same conditions.
+    runs: dict[str, list[dict]] = {"metrics_off": [], "metrics_on": []}
+    for _ in range(repeats):
+        runs["metrics_off"].append(run_fanout(num_nodes, metrics=False, **wl))
+        runs["metrics_on"].append(run_fanout(num_nodes, metrics=True, **wl))
+    out: dict = {label: max(rs, key=lambda r: r["mb_per_s"])
+                 for label, rs in runs.items()}
+    off, on = out["metrics_off"]["mb_per_s"], out["metrics_on"]["mb_per_s"]
+    out["overhead_pct"] = round((off - on) / off * 100.0, 2) if off else None
+    return out
+
+
 def markdown_table(results: dict) -> str:
     lines = []
     for name, by_mode in results.items():
@@ -203,8 +267,23 @@ def main(argv=None) -> int:
                     help="also write the raw results to this JSON file")
     ap.add_argument("--repeats", type=int, default=3,
                     help="runs per cell; the best is reported (default 3)")
+    ap.add_argument("--metrics-compare", action="store_true",
+                    help="run the 150KB zero-copy config with telemetry "
+                         "enabled vs disabled (instrumentation-overhead "
+                         "guard; see BENCH_r06.json)")
     args = ap.parse_args(argv)
     fanout = tuple(int(x) for x in args.fanout.split(",") if x)
+    if args.metrics_compare:
+        results = metrics_compare(quick=args.quick, repeats=args.repeats)
+        on, off = results["metrics_on"], results["metrics_off"]
+        print(f"metrics off: {off['mb_per_s']:,.1f} MB/s   "
+              f"metrics on: {on['mb_per_s']:,.1f} MB/s   "
+              f"overhead: {results['overhead_pct']}%")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+            print(f"raw results -> {args.json}")
+        return 0
     results = bench(quick=args.quick, fanout=fanout, repeats=args.repeats)
     print(markdown_table(results))
     if args.json:

@@ -18,12 +18,17 @@ A brand-new framework with the capabilities of TensorFlowOnSpark
   ``tensorflowonspark/pipeline.py``.
 - TFRecord + tf.train.Example codec without a TensorFlow dependency,
   replacing ``tensorflowonspark/dfutil.py`` + the tensorflow-hadoop jar.
+- Cluster-wide metrics + span tracing (``telemetry``): lock-free process
+  registries piggybacked on control-plane heartbeats, aggregated into
+  ``cluster.metrics()``, TensorBoard scalars, and an end-of-run report —
+  replacing the reference's TensorBoard-subprocess-only observability.
 
 See SURVEY.md for the reference layer map this package mirrors.
 """
 
 __version__ = "0.4.0"
 
+from tensorflowonspark_tpu import telemetry  # noqa: F401 - metrics/span API
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
 from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
 from tensorflowonspark_tpu.launcher import (  # noqa: F401

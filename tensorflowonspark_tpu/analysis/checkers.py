@@ -1,6 +1,6 @@
 """The toslint checkers — this codebase's invariants, mechanically enforced.
 
-Five disciplines, each born from a class of bug the elastic control/data
+Six disciplines, each born from a class of bug the elastic control/data
 plane makes likely (see ISSUE 2 / ROADMAP):
 
 - ``knob-discipline``: every ``TOS_*`` env read goes through
@@ -15,6 +15,10 @@ plane makes likely (see ISSUE 2 / ROADMAP):
 - ``silent-except``: ``except ...: pass`` without a log line or an explicit
   ``# toslint: allow-silent(<reason>)`` pragma — silence is how invariants
   rot.
+- ``metrics-discipline``: metric stores are created through the telemetry
+  registry, never as ad-hoc module-level dicts of counters — an ad-hoc
+  store is invisible to ``cluster.metrics()``/the run report and ignores
+  the ``TOS_METRICS`` switch.
 - ``trace-purity``: no wall-clock reads, ``np.random``, ``os.environ`` or
   global/nonlocal mutation inside ``jax.jit``/``pjit``/``shard_map``-traced
   functions — tracing bakes the first value in forever.
@@ -27,6 +31,7 @@ baseline (except the two never-baselined classes, which are always fixed).
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 from typing import Iterator
 
@@ -398,7 +403,81 @@ class SilentExceptChecker(Checker):
             and stmt.value.value is Ellipsis)
 
 
-# -- 5. trace purity ----------------------------------------------------------
+# -- 5. metrics discipline ----------------------------------------------------
+
+# Names that telegraph "this is a metrics container" — a module-level dict
+# of ad-hoc counters is invisible to cluster.metrics()/the run report and
+# bypasses the no-op TOS_METRICS switch.
+_METRICISH_NAME = re.compile(
+    r"(?:^|_)(metrics?|counters?|gauges?|histograms?|stats?|timings?)(?:_|$)",
+    re.IGNORECASE)
+# container constructors that make a mutable metrics store
+_METRIC_CONTAINER_CALLS = frozenset({
+    "dict", "defaultdict", "OrderedDict",
+})
+
+
+@register_checker
+class MetricsDisciplineChecker(Checker):
+    """Metric stores must be created through the telemetry registry
+    (``telemetry.counter/gauge/histogram``), not as ad-hoc module-level
+    dicts/``collections.Counter``s of counts: an ad-hoc store never reaches
+    the heartbeat piggyback, ``cluster.metrics()``, or the run report, and
+    ignores the ``TOS_METRICS`` kill switch."""
+
+    id = "metrics-discipline"
+    hint = ("create the metric through tensorflowonspark_tpu.telemetry "
+            "(counter()/gauge()/histogram()/timed()) so it reaches "
+            "cluster.metrics(), the run report, and the TOS_METRICS switch")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        # the registry implementation itself is the one sanctioned home
+        if "/telemetry/" in mod.path:
+            return
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            if self._is_collections_counter(mod, value):
+                yield Finding(
+                    self.id, mod.path, stmt.lineno,
+                    f"module-level collections.Counter {names[0]!r} is an "
+                    "ad-hoc metrics store outside the telemetry registry",
+                    self.hint, f"<module>@{names[0]}")
+                continue
+            if not any(_METRICISH_NAME.search(n) for n in names):
+                continue
+            if self._is_container_literal(mod, value):
+                yield Finding(
+                    self.id, mod.path, stmt.lineno,
+                    f"module-level metrics container {names[0]!r} bypasses "
+                    "the telemetry registry (invisible to cluster.metrics() "
+                    "and the TOS_METRICS switch)",
+                    self.hint, f"<module>@{names[0]}")
+
+    @staticmethod
+    def _is_collections_counter(mod: ModuleSource, value: ast.AST) -> bool:
+        return (isinstance(value, ast.Call)
+                and mod.imports.qualify(value.func) == "collections.Counter")
+
+    @staticmethod
+    def _is_container_literal(mod: ModuleSource, value: ast.AST) -> bool:
+        if isinstance(value, (ast.Dict, ast.DictComp)):
+            return True
+        if isinstance(value, ast.Call):
+            fq = mod.imports.qualify(value.func)
+            name = fq.rsplit(".", 1)[-1] if fq else _terminal_name(value.func)
+            return name in _METRIC_CONTAINER_CALLS
+        return False
+
+
+# -- 6. trace purity ----------------------------------------------------------
 
 _JIT_NAMES = frozenset({"jit", "pjit", "shard_map"})
 _IMPURE_CALL_QUALS = frozenset({

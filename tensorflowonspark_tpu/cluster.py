@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu.coordinator import CoordinatorServer
 from tensorflowonspark_tpu.data import as_partitioned
 from tensorflowonspark_tpu.dataserver import DataClient
@@ -41,6 +42,7 @@ from tensorflowonspark_tpu.launcher import (  # noqa: F401 - LocalLauncher re-ex
 )
 from tensorflowonspark_tpu.node import NodeConfig
 from tensorflowonspark_tpu.supervisor import RestartPolicy, Supervisor
+from tensorflowonspark_tpu.utils.envtune import env_bool as _env_bool
 from tensorflowonspark_tpu.utils.envtune import env_float as _env_float
 from tensorflowonspark_tpu.utils.envtune import env_int as _env_int
 
@@ -264,6 +266,7 @@ class TPUCluster:
         feed_timeout: float,
         heartbeat_interval: float = 2.0,
         elastic: bool | RestartPolicy = False,
+        log_dir: str = "",
     ):
         self.coordinator = coordinator
         self.launcher = launcher
@@ -271,6 +274,8 @@ class TPUCluster:
         self.authkey = authkey
         self.input_mode = input_mode
         self.queues = queues
+        self.log_dir = log_dir
+        self._started_at = time.monotonic()
         self.input_qnames = [q for q in queues if q not in ("output", "error")]
         self.feed_timeout = feed_timeout
         self.heartbeat_interval = heartbeat_interval
@@ -326,6 +331,18 @@ class TPUCluster:
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="dead-node-monitor")
         self._monitor.start()
+        # Periodic TensorBoard export of the aggregated cluster metrics
+        # (TOS_METRICS_EXPORT_SECS cadence; scalars land under
+        # <log_dir>/metrics via summary.SummaryWriter) — TFoS parity: the
+        # reference's only live dashboard was TensorBoard, so the metrics
+        # subsystem surfaces there too, not just in cluster.metrics().
+        self._export_stop = threading.Event()
+        self._export_thread: threading.Thread | None = None
+        if log_dir and telemetry.enabled():
+            self._export_thread = threading.Thread(
+                target=self._metrics_export_loop, daemon=True,
+                name="metrics-export")
+            self._export_thread.start()
 
     def _record_deaths(self, record_error: bool = True) -> list[int]:
         """Role-aware death bookkeeping, shared by the monitor thread and
@@ -647,9 +664,13 @@ class TPUCluster:
                     # double-count in the node's consumption watermark, while
                     # a LATER train() on a reused cluster (new generation)
                     # must count afresh
-                    state = client.feed_partition(
-                        views[epoch].iter_partition(p), qname,
-                        task_key=(train_gen,) + task)
+                    # span: wall time to stream + ack one partition (send
+                    # rate AND node-side backpressure both land in here —
+                    # the first place to look when train() slows down)
+                    with telemetry.timed("driver.feed_partition_secs"):
+                        state = client.feed_partition(
+                            views[epoch].iter_partition(p), qname,
+                            task_key=(train_gen,) + task)
                 except Exception as e:  # noqa: BLE001 - wrapped + ledgered below
                     wrapped = RuntimeError(
                         f"feeding executor {executor_id} failed on partition "
@@ -809,8 +830,9 @@ class TPUCluster:
                     try:
                         if client is None:
                             client = self._client(executor_id)
-                        part = client.infer_partition(dataset.iter_partition(p),
-                                                      qname_in, qname_out)
+                        with telemetry.timed("driver.infer_partition_secs"):
+                            part = client.infer_partition(
+                                dataset.iter_partition(p), qname_in, qname_out)
                     except Exception as e:  # noqa: BLE001 - wrapped below
                         # A failed DIAL (client is still None) sent nothing:
                         # no partial results can exist anywhere, so any live
@@ -1018,6 +1040,18 @@ class TPUCluster:
                     break
             for c in self._clients.values():
                 c.close()
+            # Run report BEFORE error propagation: a failed run is exactly
+            # when the recorded restarts/faults/spans matter most.  Every
+            # node has deregistered (or died) by now, so the coordinator's
+            # per-node store holds the final snapshots.
+            self._stop_metrics_export()
+            try:
+                if telemetry.enabled() and _env_bool("TOS_RUN_REPORT", True):
+                    report_path = self.write_run_report()
+                    if report_path:
+                        logger.info("run report written to %s", report_path)
+            except Exception:  # noqa: BLE001 - reporting must not mask errors
+                logger.warning("could not write run report", exc_info=True)
             self._raise_node_errors()
             exit_codes = [p.exitcode for p in self.launcher.processes]
             if any(code is None for code in exit_codes):
@@ -1030,7 +1064,16 @@ class TPUCluster:
                 raise RuntimeError(f"node processes exited abnormally: {exit_codes}")
         finally:
             self._shutdown_done = True
+            # idempotent: normally already stopped before the run report; an
+            # early-raising shutdown path must still reap the export thread
+            self._stop_metrics_export()
             self.coordinator.stop()
+
+    def _stop_metrics_export(self) -> None:
+        self._export_stop.set()
+        if self._export_thread is not None:
+            self._export_thread.join(timeout=10.0)
+            self._export_thread = None
 
     def _raise_node_errors(self) -> None:
         errs = self.coordinator.errors()
@@ -1042,6 +1085,86 @@ class TPUCluster:
             )
 
     # -- observability (reference TFCluster.tensorboard_url :~240-260) -------
+
+    def metrics(self) -> dict:
+        """Aggregated cluster-wide metrics snapshot.
+
+        Per-node registry snapshots (as last reported over heartbeats /
+        final deregister) plus the driver's own registry under ``"driver"``,
+        merged by ``telemetry.aggregate_snapshots``: ``"counters"`` holds
+        cluster totals, ``"histograms"`` merged span digests with pooled
+        percentiles, ``"nodes"`` the per-node detail.
+        """
+        return self.coordinator.cluster_metrics()
+
+    def debug_dump(self) -> str:
+        """Human-readable text report of ``metrics()`` (paste into a bug
+        report; the run report is the JSON twin)."""
+        return telemetry.debug_dump(self.metrics())
+
+    def write_run_report(self, path: str | None = None) -> str | None:
+        """Write the end-of-run JSON run report; returns the path (None when
+        there is nowhere to write: no ``path`` and no ``log_dir``).
+
+        Called automatically at ``shutdown()`` when ``TOS_RUN_REPORT`` is on
+        and the cluster has a ``log_dir`` — the report lands next to the
+        job's event files / checkpoints as ``run_report.json``.
+        """
+        if path is None:
+            if not self.log_dir:
+                return None
+            path = os.path.join(self.log_dir, "run_report.json")
+        report = telemetry.build_run_report(
+            self.metrics(),
+            wall_secs=round(time.monotonic() - self._started_at, 3),
+            extras={
+                "num_executors": len(self.cluster_info),
+                "node_errors": len(self.coordinator.errors()),
+                "restarts_by_executor": (
+                    {str(eid): self.supervisor.restart_count(eid)
+                     for eid in self._feed_ids
+                     if self.supervisor.restart_count(eid)}
+                    if self.supervisor is not None else {}),
+            })
+        return telemetry.write_run_report(path, report)
+
+    def _metrics_export_loop(self) -> None:
+        """Every ``TOS_METRICS_EXPORT_SECS``: aggregate + write TB scalars."""
+        from tensorflowonspark_tpu.summary import SummaryWriter
+
+        period = _env_float("TOS_METRICS_EXPORT_SECS", 30.0)
+        writer: SummaryWriter | None = None
+        step = 0
+        while not self._export_stop.wait(period):
+            step += 1
+            try:
+                if writer is None:
+                    writer = SummaryWriter(os.path.join(self.log_dir, "metrics"))
+                self._export_metrics_once(writer, step)
+            except Exception:  # noqa: BLE001 - observability must not kill jobs
+                logger.warning("metrics export failed", exc_info=True)
+        # final flush on stop so short runs still leave a scalar trail
+        try:
+            if writer is None:
+                writer = SummaryWriter(os.path.join(self.log_dir, "metrics"))
+            self._export_metrics_once(writer, step + 1)
+            writer.close()
+        except Exception:  # noqa: BLE001
+            logger.debug("final metrics export failed", exc_info=True)
+
+    def _export_metrics_once(self, writer, step: int) -> None:
+        snap = self.metrics()
+        scalars: dict[str, float] = {}
+        for name, value in (snap.get("counters") or {}).items():
+            scalars[f"metrics/{name}"] = float(value)
+        for name, d in (snap.get("histograms") or {}).items():
+            for key in ("mean", "p50", "p90", "p99"):
+                v = d.get(key)
+                if v is not None:
+                    scalars[f"metrics/{name}/{key}"] = float(v)
+        if scalars:
+            writer.add_scalars(scalars, step=step)
+            writer.flush()
 
     def chip_plan(self):
         """Authoritative global chip numbering across the registered nodes
@@ -1189,4 +1312,5 @@ def run(
         raise
     logger.info("cluster up: %s", [(m["executor_id"], m["job_name"]) for m in cluster_info])
     return TPUCluster(coordinator, launcher, cluster_info, authkey, input_mode,
-                      queues, feed_timeout, heartbeat_interval, elastic=elastic)
+                      queues, feed_timeout, heartbeat_interval, elastic=elastic,
+                      log_dir=log_dir)

@@ -33,7 +33,14 @@ import threading
 import time
 from typing import Any
 
+from tensorflowonspark_tpu import telemetry
+
 logger = logging.getLogger(__name__)
+
+# Per-node "recent span samples" kept for cluster-wide percentile pooling
+# (each heartbeat delta ships up to telemetry.OUTBOX_SIZE new samples per
+# histogram; the store keeps a bounded tail per (node, metric)).
+_HIST_RECENT_CAP = 256
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -70,6 +77,8 @@ class _Rendezvous:
         self.result: Any = None
         self.done = False
         self.aborted = False
+        # span anchor: generation open -> last participant closes it
+        self.t0 = time.monotonic()
 
 
 def _reduce(kind: str, values: list[Any]) -> Any:
@@ -122,6 +131,14 @@ class CoordinatorServer:
         # not its life — is rejected, so a restarted replacement can never
         # race its predecessor on heartbeats, barriers, or reduces.
         self._incarnations: dict[int, int] = {}
+        # Telemetry store: the latest raw registry snapshot per executor,
+        # merged key-by-key from the compact deltas nodes piggyback on
+        # heartbeats (and the final snapshot sent with deregister).  Values
+        # are absolute cumulative per process, so merging is replacement and
+        # a dropped heartbeat never loses counts; a restarted slot's
+        # counters restart with its process (per-incarnation counters).
+        self._node_metrics: dict[int, dict] = {}
+        self._hist_recent: dict[int, dict[str, list[float]]] = {}
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.address: tuple[str, int] | None = None
@@ -271,7 +288,10 @@ class CoordinatorServer:
                                       "or host unreachable); detected by driver "
                                       "monitor (SURVEY.md §5.3)"),
                     })
+            live = len(self._last_seen)
         if newly:
+            telemetry.counter("coordinator.deaths_total").inc(len(newly))
+            telemetry.gauge("coordinator.live_slots").set(live)
             self._abort_rendezvous()
         return newly
 
@@ -291,6 +311,51 @@ class CoordinatorServer:
         with self._lock:
             return (self._incarnations.get(executor_id, 0),
                     executor_id in self._last_seen)
+
+    # -- telemetry (cluster metrics transport) -------------------------------
+
+    def _merge_metrics_locked(self, executor_id: int, payload: dict) -> None:
+        """Fold one node's heartbeat delta into its stored snapshot.  Every
+        value in the payload is absolute-cumulative, so the merge is plain
+        replacement per key; histogram ``recent`` samples append to a
+        bounded per-(node, metric) pool for cluster-wide percentiles."""
+        store = self._node_metrics.setdefault(
+            executor_id, {"counters": {}, "gauges": {}, "histograms": {}})
+        store["counters"].update(payload.get("counters") or {})
+        store["gauges"].update(payload.get("gauges") or {})
+        for name, d in (payload.get("histograms") or {}).items():
+            store["histograms"][name] = {
+                k: d.get(k) for k in ("count", "sum", "min", "max")}
+            recent = d.get("recent")
+            if recent:
+                pool = self._hist_recent.setdefault(
+                    executor_id, {}).setdefault(name, [])
+                pool.extend(float(v) for v in recent)
+                del pool[:-_HIST_RECENT_CAP]
+
+    def cluster_metrics(self) -> dict:
+        """Aggregated cluster snapshot (the ``metrics`` op / the
+        ``cluster.metrics()`` driver API): per-node registry snapshots as
+        last reported over heartbeats, plus THIS process's registry under
+        ``"driver"`` (the coordinator runs in the driver, whose registry
+        holds the feed-pump, supervisor, and rendezvous-span metrics)."""
+        with self._lock:
+            nodes: dict[str, dict] = {}
+            for eid, snap in self._node_metrics.items():
+                hists = {}
+                for name, d in snap["histograms"].items():
+                    d = dict(d)
+                    recent = self._hist_recent.get(eid, {}).get(name)
+                    if recent:
+                        d["recent"] = list(recent)
+                    hists[name] = d
+                nodes[str(eid)] = {"counters": dict(snap["counters"]),
+                                   "gauges": dict(snap["gauges"]),
+                                   "histograms": hists}
+        driver = telemetry.snapshot(include_samples=True)
+        if any(driver.values()):
+            nodes["driver"] = driver
+        return telemetry.aggregate_snapshots(nodes)
 
     def _abort_rendezvous(self) -> None:
         """Abort every in-flight barrier/reduce generation (peer death)."""
@@ -362,16 +427,29 @@ class CoordinatorServer:
             if op == "heartbeat":
                 with self._lock:
                     # a deregistered (cleanly exited) node sends no further
-                    # beats; never resurrect one from a late in-flight ping
+                    # beats; never resurrect one from a late in-flight ping —
+                    # and never let such a ping's metric delta overwrite the
+                    # FINAL snapshot the deregister already merged (the
+                    # heartbeat thread races teardown on its own connection)
                     if msg["executor_id"] in self._last_seen:
                         self._last_seen[msg["executor_id"]] = time.monotonic()
+                        if msg.get("metrics"):
+                            self._merge_metrics_locked(int(msg["executor_id"]),
+                                                       msg["metrics"])
                 return {"ok": True, "stop": self._stop_flag.is_set()}
+            if op == "metrics":
+                return {"ok": True, "snapshot": self.cluster_metrics()}
             if op == "deregister":
                 # node exiting deliberately (map_fun done, or error already
                 # reported): stop liveness tracking so the driver's dead-node
-                # monitor never flags a clean exit as a death
+                # monitor never flags a clean exit as a death.  The final
+                # metrics snapshot rides along — work done after the last
+                # heartbeat must still reach the cluster view.
                 with self._lock:
                     self._last_seen.pop(msg["executor_id"], None)
+                    if msg.get("metrics"):
+                        self._merge_metrics_locked(int(msg["executor_id"]),
+                                                   msg["metrics"])
                 return {"ok": True}
             if op == "error":
                 with self._lock:
@@ -404,6 +482,8 @@ class CoordinatorServer:
             incarnation = self._incarnations.get(executor_id, 0)
             if len(self._nodes) == self.expected:
                 self._complete.set()
+            live = len(self._last_seen)
+        telemetry.gauge("coordinator.live_slots").set(live)
         logger.info("registered node %d as %s:%d (%s)", executor_id, job_name, task_index, meta.get("host"))
         return {"ok": True, "executor_id": executor_id, "job_name": job_name,
                 "task_index": task_index, "expected": self.expected,
@@ -432,6 +512,8 @@ class CoordinatorServer:
             slot.update(meta)
             self._last_seen[executor_id] = time.monotonic()
             incarnation = self._incarnations.get(executor_id, 0)
+            live = len(self._last_seen)
+        telemetry.gauge("coordinator.live_slots").set(live)
         logger.info("replacement registered for node %d as %s:%d (%s, incarnation %d)",
                     executor_id, job_name, task_index, meta.get("host"), incarnation)
         return {"ok": True, "executor_id": executor_id, "job_name": job_name,
@@ -463,6 +545,10 @@ class CoordinatorServer:
             if len(rdv.values) == rdv.count:
                 rdv.result = _reduce(kind, rdv.values)
                 rdv.done = True
+                # consensus latency span: generation open -> last arrival
+                # (the SURVEY §5.8-3 number ops watch when scaling steps)
+                telemetry.histogram("coordinator.rendezvous_secs").observe(
+                    time.monotonic() - rdv.t0)
                 with self._lock:
                     if self._rdv.get(name) is rdv:
                         del self._rdv[name]
@@ -617,16 +703,30 @@ class CoordinatorClient:
         """Patch this node's registered metadata (e.g. tensorboard URL)."""
         self._check(self._call({"op": "update_meta", "executor_id": executor_id, "patch": patch}))
 
-    def heartbeat(self, executor_id: int) -> bool:
-        """Send liveness ping; returns True if the driver asked us to stop."""
-        return bool(self._check(self._call({"op": "heartbeat", "executor_id": executor_id}))["stop"])
+    def heartbeat(self, executor_id: int, metrics: dict | None = None) -> bool:
+        """Send liveness ping; returns True if the driver asked us to stop.
+        ``metrics`` piggybacks a compact telemetry delta
+        (``telemetry.collect_changed``) on the ping — the cluster metrics
+        transport costs no extra round-trips."""
+        msg: dict = {"op": "heartbeat", "executor_id": executor_id}
+        if metrics:
+            msg["metrics"] = metrics
+        return bool(self._check(self._call(msg))["stop"])
+
+    def metrics(self) -> dict:
+        """Aggregated cluster metrics snapshot (the ``metrics`` op)."""
+        return self._check(self._call({"op": "metrics"}))["snapshot"]
 
     def report_error(self, executor_id: int, traceback_str: str) -> None:
         self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})
 
-    def deregister(self, executor_id: int) -> None:
-        """Announce a deliberate exit (stops dead-node tracking for this id)."""
-        self._call({"op": "deregister", "executor_id": executor_id})
+    def deregister(self, executor_id: int, metrics: dict | None = None) -> None:
+        """Announce a deliberate exit (stops dead-node tracking for this id);
+        ``metrics`` carries the node's final telemetry snapshot."""
+        msg: dict = {"op": "deregister", "executor_id": executor_id}
+        if metrics:
+            msg["metrics"] = metrics
+        self._call(msg)
 
     def request_stop(self) -> None:
         self._call({"op": "stop"})

@@ -372,6 +372,18 @@ def pack_chunk(items: list) -> PackedChunk | None:
     """Columnar-pack a homogeneous chunk, or None when it does not qualify
     (the caller then sends the plain list — semantics are identical either
     way; packing only changes how the bytes travel)."""
+    packed = _pack_chunk_inner(items)
+    # pack-vs-fallback counts: a feed that silently stopped qualifying for
+    # the zero-copy path (heterogeneous rows, sub-threshold sizes) shows up
+    # here instead of as an unexplained throughput regression
+    from tensorflowonspark_tpu import telemetry
+
+    telemetry.counter("dataplane.chunks_packed" if packed is not None
+                      else "dataplane.chunks_unpacked").inc()
+    return packed
+
+
+def _pack_chunk_inner(items: list) -> PackedChunk | None:
     if not items:
         return None
     first = items[0]

@@ -48,7 +48,7 @@ import threading
 from time import monotonic as _monotonic
 from typing import Any, Iterable
 
-from tensorflowonspark_tpu import faultinject
+from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.data import _MIN_OOB_ROW_BYTES as _MIN_OOB_BYTES
 from tensorflowonspark_tpu.data import pack_chunk as _pack_chunk
 from tensorflowonspark_tpu.data import unpack_items as _unpack_items
@@ -123,12 +123,18 @@ def _send(sock: socket.socket, obj: Any, wire: int = 1) -> None:
         body, raws = _vec_parts(obj)
         header = bytearray(_LEN.pack(_VEC_BIT | (len(raws) + 1)))
         header += _LEN.pack(len(body))
+        total = len(body)
         for r in raws:
             header += _LEN.pack(r.nbytes)
+            total += r.nbytes
         _sendmsg_all(sock, [header, body, *raws])
+        telemetry.counter("dataplane.tx_bytes").inc(total + len(header))
+        telemetry.counter("dataplane.tx_frames").inc()
         return
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     _sendmsg_all(sock, [_LEN.pack(len(data)), data])
+    telemetry.counter("dataplane.tx_bytes").inc(8 + len(data))
+    telemetry.counter("dataplane.tx_frames").inc()
 
 
 # Frames up to this size are received into one preallocated buffer (the
@@ -170,9 +176,13 @@ def _recv_frame(sock: socket.socket) -> tuple[Any, bool]:
         for ln in lens[1:]:
             bufs.append(view[off:off + ln])
             off += ln
+        telemetry.counter("dataplane.rx_bytes").inc(8 + 8 * nsec + sum(lens))
+        telemetry.counter("dataplane.rx_frames").inc()
         return pickle.loads(body, buffers=bufs), True
     # v1: one length-framed pickle, received into a single preallocated
     # buffer and unpickled in place (no full-frame bytes() copy)
+    telemetry.counter("dataplane.rx_bytes").inc(8 + word)
+    telemetry.counter("dataplane.rx_frames").inc()
     return pickle.loads(_recv_sized(sock, word)), False
 
 
@@ -217,13 +227,22 @@ def _ring_loads(blob: bytes) -> tuple[Any, bool]:
 
 def _ring_send(ring, obj: Any, wire: int, timeout: float | None) -> None:
     if wire >= 2:
-        ring.put_buffers(_ring_vec_record(obj), timeout=timeout)
+        bufs = _ring_vec_record(obj)
+        ring.put_buffers(bufs, timeout=timeout)
+        telemetry.counter("dataplane.tx_bytes").inc(
+            sum(b.nbytes if isinstance(b, memoryview) else len(b)
+                for b in bufs))
+        telemetry.counter("dataplane.tx_frames").inc()
         return
     ring.put(obj, timeout=timeout)
+    telemetry.counter("dataplane.tx_frames").inc()
 
 
 def _ring_recv(ring, timeout: float | None) -> tuple[Any, bool]:
-    return _ring_loads(ring.get_bytes(timeout=timeout))
+    blob = ring.get_bytes(timeout=timeout)
+    telemetry.counter("dataplane.rx_bytes").inc(len(blob))
+    telemetry.counter("dataplane.rx_frames").inc()
+    return _ring_loads(blob)
 
 
 class DataServer:
@@ -331,6 +350,8 @@ class DataServer:
         if op == "feed":
             _, qname, items = msg
             items = _unpack_items(items)
+            telemetry.counter("dataplane.chunks_in").inc()
+            telemetry.counter("dataplane.rows_in").inc(len(items))
             if self.queues.get("state") == "terminating":
                 return ("ok", "terminating")  # fast-drain: drop silently
             q = self.queues.get_queue(qname)
@@ -387,6 +408,8 @@ class DataServer:
             # whole feed_timeout (VERDICT r2 weak #7).
             _, qname, items, want_end = msg
             items = _unpack_items(items)
+            telemetry.counter("dataplane.chunks_in").inc()
+            telemetry.counter("dataplane.rows_in").inc(len(items))
             if self.queues.get("state") == "terminating":
                 return ("ok", len(items), True, "terminating")
             q = self.queues.get_queue(qname)
@@ -603,6 +626,10 @@ class DataClient:
                     else None
             if forced is not False:
                 self._try_ring_setup(host, probe=forced is None)
+        # transport selection, one count per client connection (the ring
+        # probe decision is otherwise invisible outside debug logs)
+        telemetry.counter("dataplane.clients_ring" if self.using_ring
+                          else "dataplane.clients_tcp").inc()
 
     def _negotiate_wire(self) -> int:
         """Probe the server's wire version with a v1 ``hello``: a current
@@ -704,6 +731,7 @@ class DataClient:
 
     def _teardown_ring(self) -> None:
         if self._c2s is not None:
+            telemetry.counter("dataplane.ring_downgrades").inc()
             for ring in (self._c2s, self._s2c):
                 try:
                     ring.detach()
@@ -718,6 +746,8 @@ class DataClient:
             packed = _pack_chunk(chunk)
             if packed is not None:
                 return packed
+            return chunk
+        telemetry.counter("dataplane.chunks_legacy_wire").inc()
         return chunk
 
     def feed_partition(self, items: Iterable[Any], qname: str = "input",
@@ -783,11 +813,14 @@ class DataClient:
         window = max(1, int(self.send_window))
         outstanding = 0
         state = "running"
+        chunks_sent = rows_sent = 0
+        occupancy = telemetry.gauge("dataplane.send_window_occupancy")
 
         def drain_one() -> None:
             nonlocal outstanding, state
             reply = self._check(recv())
             outstanding -= 1
+            occupancy.set(outstanding)
             if len(reply) > 1 and reply[1] == "terminating":
                 state = "terminating"
 
@@ -797,8 +830,11 @@ class DataClient:
             if len(chunk) >= self.chunk_size:
                 with self.sender_gate():
                     send(("feed", qname, self._pack_items(chunk)))
+                chunks_sent += 1
+                rows_sent += len(chunk)
                 chunk = []
                 outstanding += 1
+                occupancy.set(outstanding)
                 while outstanding >= window:
                     drain_one()
                 if state == "terminating":
@@ -806,9 +842,14 @@ class DataClient:
         if chunk and state != "terminating":
             with self.sender_gate():
                 send(("feed", qname, self._pack_items(chunk)))
+            chunks_sent += 1
+            rows_sent += len(chunk)
             outstanding += 1
+            occupancy.set(outstanding)
         while outstanding:
             drain_one()
+        telemetry.counter("dataplane.chunks_sent").inc(chunks_sent)
+        telemetry.counter("dataplane.rows_sent").inc(rows_sent)
         return state
 
     def partitions_consumed(self, qname: str = "input") -> int | None:

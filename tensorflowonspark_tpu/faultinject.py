@@ -123,11 +123,24 @@ class FaultPlan:
                 if a.name in self._WINDOWED:
                     if a.count >= a.threshold:
                         a.fired = True
+                    self._count_injection(name)
                     return True
                 if a.count >= a.threshold:
                     a.fired = True
+                    self._count_injection(name)
                     return True
         return False
+
+    @staticmethod
+    def _count_injection(name: str) -> None:
+        """Meter the fired fault (telemetry): chaos tests assert recovery
+        counters against these, and a soak run's report shows how many
+        faults it actually exercised.  A ``kill`` SIGKILLs before the next
+        heartbeat can ship the count — that loss is the fault's own point."""
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.counter("faultinject.injected_total").inc()
+        telemetry.counter(f"faultinject.injected.{name}").inc()
 
 
 _PLAN: FaultPlan | None = None

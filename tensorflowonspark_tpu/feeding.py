@@ -32,7 +32,7 @@ import queue
 import threading
 from typing import Any, Iterable, Sequence
 
-from tensorflowonspark_tpu import faultinject
+from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker
 
 
@@ -72,6 +72,7 @@ class FeedQueues:
                     return  # re-fed duplicate of a partition already counted
                 seen.add(key)
             self._consumed[qname] = self._consumed.get(qname, 0) + 1
+        telemetry.counter("feed.partitions_consumed").inc()
 
     def partitions_consumed(self, qname: str) -> int:
         with self._lock:
@@ -199,6 +200,8 @@ class DataFeed:
                 continue
             batch.append(item)
         if batch:
+            telemetry.counter("feed.batches").inc()
+            telemetry.counter("feed.rows_consumed").inc(len(batch))
             # Chaos hook (no-op unless TOS_FAULTINJECT armed a `kill`): a
             # consumed batch is the deterministic clock for "die after N
             # batches" — the most brutal mid-epoch death available.

@@ -242,6 +242,22 @@ class NodeContext:
         test/driver wants to observe after shutdown."""
         self._client.update_meta(self.executor_id, patch)
 
+    # -- telemetry -----------------------------------------------------------
+
+    @property
+    def metrics(self):
+        """This process's telemetry registry — the ``map_fun``-facing metrics
+        surface.  Anything recorded here rides the heartbeat piggyback into
+        ``cluster.metrics()`` / the run report, e.g.::
+
+            ctx.metrics.gauge("train.steps_per_sec").set(rate)
+            ctx.metrics.counter("train.samples").inc(n)
+            with ctx.metrics.timed("train.step_secs"): ...
+        """
+        from tensorflowonspark_tpu import telemetry
+
+        return telemetry.get_registry()
+
 
 _barrier_counter = [0]
 
@@ -384,7 +400,10 @@ def node_main(config: NodeConfig) -> int:
                              "failure report either", exc_info=True)
             _enter_stop_state()
             return
+        from tensorflowonspark_tpu import telemetry
+
         failures = 0
+        metrics_state: dict | None = None
         while not stop_requested.is_set():
             if faultinject.drop_heartbeat():
                 # Chaos hook: swallow this liveness ping (models a network
@@ -393,10 +412,27 @@ def node_main(config: NodeConfig) -> int:
                 time.sleep(config.heartbeat_interval)
                 continue
             try:
-                stop = hb_client.heartbeat(executor_id)
+                # Compact telemetry delta piggybacks on the ping (absolute
+                # cumulative values, changed keys only): the cluster metrics
+                # transport costs zero extra round-trips, and a delta lost
+                # with a failed ping is re-sent implicitly by the next one.
+                payload: dict | None = None
+                if telemetry.enabled():
+                    payload, metrics_state = telemetry.collect_changed(
+                        metrics_state)
+                stop = hb_client.heartbeat(executor_id,
+                                           metrics=payload or None)
                 failures = 0
             except Exception:
                 failures += 1
+                # the delta that rode the failed ping may be lost: drop the
+                # dedupe state so the next successful ping re-sends a full
+                # snapshot (values are absolute — re-sending is idempotent),
+                # and give the drained span samples back to their outboxes
+                # (the one part of a delta that is NOT re-derivable)
+                metrics_state = None
+                if payload:
+                    telemetry.get_registry().restore_recent(payload)
                 if failures >= 3:
                     # Coordinator gone (driver exited/crashed): treat exactly
                     # like a stop signal so map_fun unblocks instead of
@@ -493,7 +529,10 @@ def node_main(config: NodeConfig) -> int:
     exit_code = 0
     try:
         logger.info("node %d (%s:%d) invoking map_fun", executor_id, ident["job_name"], ident["task_index"])
-        config.map_fun(config.tf_args, ctx)
+        from tensorflowonspark_tpu import telemetry
+
+        with telemetry.timed("node.map_fun_secs"):
+            config.map_fun(config.tf_args, ctx)
     except Exception:
         tb = traceback.format_exc()
         logger.error("map_fun failed:\n%s", tb)
@@ -513,8 +552,15 @@ def node_main(config: NodeConfig) -> int:
         try:
             # Deliberate exit (normal completion, or error already reported
             # above): tell the driver to stop liveness-tracking this node so
-            # its monitor never mistakes the exit for a death.
-            client.deregister(executor_id)
+            # its monitor never mistakes the exit for a death.  The final
+            # telemetry snapshot rides along — metrics recorded after the
+            # last heartbeat (tail batches, the map_fun span itself) must
+            # still reach the driver's cluster view.
+            from tensorflowonspark_tpu import telemetry
+
+            final_metrics = (telemetry.collect_changed(None)[0]
+                             if telemetry.enabled() else None)
+            client.deregister(executor_id, metrics=final_metrics or None)
         except Exception:
             logger.debug("deregister failed during teardown (driver may "
                          "flag this exit as a death)", exc_info=True)

@@ -16,6 +16,7 @@ TensorBoard's scalar dashboard reads exactly this subset.
 
 from __future__ import annotations
 
+import atexit
 import os
 import struct
 import time
@@ -54,28 +55,59 @@ def _encode_event(wall_time: float, step: int, scalars: dict[str, float] | None,
 
 
 class SummaryWriter:
-    """Write TensorBoard scalar events (one file per writer)."""
+    """Write TensorBoard scalar events (one file per writer).
 
-    def __init__(self, log_dir: str, filename_suffix: str = ""):
+    Crash-robust by default: nodes in an elastic cluster get killed mid-run
+    (supervised restarts, ``TOS_FAULTINJECT`` kills, preemption), and an
+    event file cut inside a buffered record is truncated garbage from the
+    last flush onward.  So the writer (a) flushes at *record boundaries* on
+    a ``flush_secs`` cadence — a hard kill can only cost the last few
+    seconds of scalars, never leave a half-written record the OS already
+    had; (b) registers an ``atexit`` close so orderly teardowns (SIGTERM
+    handlers, interpreter exit with the writer still open) always land a
+    complete file; (c) makes ``close()`` idempotent, so atexit after an
+    explicit close (or the context manager) is a no-op.
+    """
+
+    def __init__(self, log_dir: str, filename_suffix: str = "",
+                 flush_secs: float = 5.0):
         log_dir = resolve_uri(log_dir)
         os.makedirs(log_dir, exist_ok=True)
         fname = f"events.out.tfevents.{time.time():.0f}.{os.getpid()}{filename_suffix}"
         self._writer = RecordWriter(os.path.join(log_dir, fname))
+        self._flush_secs = max(0.0, float(flush_secs))
+        self._closed = False
         # TensorBoard requires a leading file_version event.
         self._writer.write(_encode_event(time.time(), 0, None, file_version="brain.Event:2"))
         self._writer.flush()
+        self._last_flush = time.monotonic()
+        atexit.register(self.close)
+
+    def _wrote_record(self) -> None:
+        if time.monotonic() - self._last_flush >= self._flush_secs:
+            self.flush()
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         self._writer.write(_encode_event(time.time(), step, {tag: value}))
+        self._wrote_record()
 
     def add_scalars(self, scalars: dict[str, float], step: int) -> None:
         self._writer.write(_encode_event(time.time(), step, scalars))
+        self._wrote_record()
 
     def flush(self) -> None:
-        self._writer.flush()
+        if not self._closed:
+            self._writer.flush()
+            self._last_flush = time.monotonic()
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._writer.close()
+        # a closed writer needs no interpreter-exit hook (and unregistering
+        # keeps long-lived processes from accumulating dead callbacks)
+        atexit.unregister(self.close)
 
     def __enter__(self) -> "SummaryWriter":
         return self

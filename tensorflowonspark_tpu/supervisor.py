@@ -36,6 +36,7 @@ import logging
 import threading
 import time
 
+from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int
 from tensorflowonspark_tpu.utils.net import backoff_delay
 
@@ -132,6 +133,7 @@ class Supervisor:
     def _fail_permanently(self, executor_id: int, reason: str) -> None:
         with self._lock:
             self._permanent[executor_id] = reason
+        telemetry.counter("elastic.permanent_failures").inc()
         logger.error("executor %d permanently failed: %s", executor_id, reason)
         # Surface through the node-error channel and fail fast, exactly like
         # the non-elastic path would have on first death.
@@ -209,6 +211,7 @@ class Supervisor:
                 # zombie (network partition, dropped heartbeats) must release
                 # the slot's ports/devices before its replacement takes them.
                 self.launcher.respawn(launch_index, config)
+                telemetry.counter("elastic.restarts_total").inc()
                 logger.info("executor %d respawned (launch_index %d, restart %d)",
                             executor_id, launch_index, attempt + 1)
                 if self._await_reregister(executor_id):

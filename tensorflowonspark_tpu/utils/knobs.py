@@ -58,6 +58,15 @@ _ALL = (
     Knob("TOS_MAX_PARTITION_ATTEMPTS", "int", "3",
          "Total feed attempts per partition (at-least-once ledger) before "
          "the job fails."),
+    Knob("TOS_METRICS", "bool", "1",
+         "Telemetry master switch: 0 makes every counter/gauge/histogram a "
+         "no-op and stops the heartbeat metric piggyback."),
+    Knob("TOS_METRICS_EXPORT_SECS", "float", "30",
+         "Cadence of the driver's periodic aggregated-metrics export to "
+         "TensorBoard scalars (written under <log_dir>/metrics)."),
+    Knob("TOS_RUN_REPORT", "bool", "1",
+         "Write the end-of-run JSON run report (run_report.json in the "
+         "cluster log_dir) at shutdown; needs TOS_METRICS on."),
     Knob("TOS_MAX_RESTARTS", "int", "2",
          "Supervised restarts allowed per executor slot before it is "
          "permanently failed."),

@@ -30,6 +30,26 @@ def sum_batches(args, ctx):
         f.write(f"{total} {count}")
 
 
+def metered_sum_batches(args, ctx):
+    """sum_batches plus explicit ``ctx.metrics`` usage — the user-facing
+    telemetry surface: everything recorded here must ride the heartbeat
+    piggyback into ``cluster.metrics()`` and the run report."""
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0.0
+    count = 0
+    with ctx.metrics.timed("train.drain_secs"):
+        while not feed.should_stop():
+            batch = feed.next_batch(args["batch_size"])
+            total += sum(batch)
+            count += len(batch)
+            if batch:
+                ctx.metrics.counter("train.user_batches").inc()
+    ctx.metrics.gauge("train.total_sum").set(total)
+    out = os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{total} {count}")
+
+
 def echo_inference(args, ctx):
     """Classic inference loop: read batches, emit one result per input item."""
     feed = ctx.get_data_feed(train_mode=False)

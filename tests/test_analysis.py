@@ -454,6 +454,70 @@ def test_trace_purity_quiet_on_pure_jit_and_untraced_impurity():
         """, f"{PKG}/summary.py", "trace-purity") == []
 
 
+# -- metrics discipline -------------------------------------------------------
+
+
+def test_metrics_fires_on_module_level_counter_dicts():
+    found = lint(
+        """
+        _METRICS = {}
+        REQUEST_COUNTERS: dict = {}
+        frame_stats = dict()
+        """, f"{PKG}/somemod.py", "metrics-discipline")
+    assert {f.anchor for f in found} == {
+        "<module>@_METRICS", "<module>@REQUEST_COUNTERS",
+        "<module>@frame_stats"}
+    assert all("telemetry" in f.hint for f in found)
+
+
+def test_metrics_fires_on_collections_counter_any_name():
+    found = lint(
+        """
+        import collections
+        from collections import Counter
+        SEEN = collections.Counter()
+        tallies = Counter()
+        """, f"{PKG}/somemod.py", "metrics-discipline")
+    assert {f.anchor for f in found} == {"<module>@SEEN", "<module>@tallies"}
+
+
+def test_metrics_fires_on_defaultdict_store():
+    found = lint(
+        """
+        from collections import defaultdict
+        BYTE_COUNTERS = defaultdict(int)
+        """, f"{PKG}/somemod.py", "metrics-discipline")
+    assert len(found) == 1 and "BYTE_COUNTERS" in found[0].message
+
+
+def test_metrics_quiet_on_registry_usage_and_non_metric_names():
+    # the sanctioned path: metrics created through the telemetry registry
+    assert lint(
+        """
+        from tensorflowonspark_tpu import telemetry
+        _TX = telemetry.counter("dataplane.tx_bytes")
+        def f(n):
+            _TX.inc(n)
+        """, f"{PKG}/somemod.py", "metrics-discipline") == []
+    # non-metric-named module dicts (registries, tables) stay quiet
+    assert lint(
+        """
+        KNOBS = {}
+        _ROUTES: dict = {}
+        _barrier_counter = [0]
+        def g():
+            local_counters = {}
+            return local_counters
+        """, f"{PKG}/somemod.py", "metrics-discipline") == []
+
+
+def test_metrics_quiet_inside_telemetry_package():
+    assert lint(
+        """
+        _METRICS = {}
+        """, f"{PKG}/telemetry/registry.py", "metrics-discipline") == []
+
+
 # -- baseline round-trip + ids ------------------------------------------------
 
 _VIOLATION = """

@@ -30,6 +30,21 @@ def test_run_fanout_legacy_wire_smoke():
     assert r["wire"] == 1 and r["rows_per_s"] > 0
 
 
+def test_metrics_compare_smoke_runs_both_legs():
+    """The instrumentation-overhead guard must keep running (BENCH_r06):
+    both legs complete, count every row, and report the overhead ratio.
+    The 3% acceptance bar itself is asserted on the committed full-size
+    numbers (BENCH_r06.json), not on this CI box's noisy quick run."""
+    r = bench_dataplane.metrics_compare(quick=True, num_nodes=1, repeats=1)
+    assert r["metrics_on"]["mb_per_s"] > 0
+    assert r["metrics_off"]["mb_per_s"] > 0
+    assert isinstance(r["overhead_pct"], float)
+    # the off leg must actually have disabled the registry for its run and
+    # restored the ambient default afterwards
+    from tensorflowonspark_tpu import telemetry
+    assert telemetry.enabled()
+
+
 @pytest.mark.slow
 def test_bench_quick_table_renders():
     results = bench_dataplane.bench(quick=True, fanout=(1, 2))

@@ -18,6 +18,41 @@ def test_writes_event_file(tmp_path):
     assert len(files) == 1
 
 
+def test_close_is_idempotent_and_unregisters_atexit(tmp_path):
+    import atexit
+
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar("loss", 1.0, step=1)
+    w.close()
+    w.close()  # double close (explicit + context manager / atexit) is a no-op
+    # the atexit hook was unregistered: interpreter exit won't re-close
+    atexit.unregister(w.close)  # no-op if already gone; must not raise
+    with pytest.raises(ValueError):
+        w._writer.write(b"x")  # underlying file really closed
+
+
+def test_records_hit_disk_at_flush_boundaries_without_close(tmp_path):
+    """Elastic-restart robustness: a writer with flush_secs=0 flushes at
+    every record boundary, so a SIGKILLed node leaves a complete event file
+    from the OS's point of view — no truncated mid-record tail."""
+    import glob as g
+
+    w = SummaryWriter(str(tmp_path), flush_secs=0.0)
+    for step in range(3):
+        w.add_scalar("loss", float(step), step=step)
+    path = g.glob(str(tmp_path / "events.out.tfevents.*"))[0]
+    import os
+
+    size_before_close = os.path.getsize(path)
+    w.close()
+    # nothing was still buffered: close added no bytes
+    assert os.path.getsize(path) == size_before_close
+    from tensorflowonspark_tpu.tfrecord import read_records
+
+    records = list(read_records(path))
+    assert len(records) == 4  # file_version event + 3 scalars
+
+
 @pytest.mark.skipif(not HAVE_TB, reason="tensorboard not installed")
 @pytest.mark.slow
 def test_tensorboard_can_parse(tmp_path):
