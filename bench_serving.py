@@ -1,0 +1,277 @@
+"""Online-serving microbench: closed-loop clients vs the gateway, CPU-side.
+
+Measures the request/response path on one box: a real 2-node cluster runs
+``serving_loop`` over a tiny linear bundle and C closed-loop clients
+(send, wait, repeat) hammer the gateway for a fixed duration.  Reported
+per config: sustained qps, p50/p99/mean request latency, row throughput.
+
+Three configs, all against one ``max_batch=64`` gateway:
+
+- ``1row`` — 1-row requests through the native ``gateway.predict`` API
+  (in-process client threads).  This is the **gateway capacity** number
+  and the acceptance config: it measures admission → micro-batching →
+  routing → node round → scatter, without the bench's own client
+  processes competing for this small box's cores.
+- ``1row_tcp`` — the same shape through the TCP wire endpoint, client
+  processes + ``GatewayClient`` connections.  On a 2-core box the clients,
+  driver, and both nodes share the CPUs, so this is a lower bound that
+  mostly measures the box (recorded for honesty, not gated).
+- ``64row_tcp`` — 64-row requests over TCP: each request IS a full static
+  batch; the throughput-leaning shape.
+
+Acceptance gate (ISSUE 5): the 2-node loopback gateway sustains >= 500
+req/s at max_batch=64 with p99 <= 5x p50 (the ``1row`` config).
+
+Usage::
+
+    python bench_serving.py                  # full table, markdown + JSON
+    python bench_serving.py --quick          # small sizes (CI smoke)
+    python bench_serving.py --json out.json
+
+Run on an otherwise idle box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import tempfile
+import threading
+import time
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _stats(lats: list[float], elapsed: float, request_rows: int,
+           clients: int, transport: str) -> dict:
+    lats = sorted(lats)
+    n = len(lats)
+    if not n:
+        raise RuntimeError("no requests completed")
+    return {
+        "transport": transport,
+        "request_rows": request_rows,
+        "clients": clients,
+        "duration_s": round(elapsed, 2),
+        "requests": n,
+        "qps": round(n / elapsed, 1),
+        "rows_per_s": round(n * request_rows / elapsed, 1),
+        "p50_ms": round(_percentile(lats, 0.50) * 1e3, 2),
+        "p99_ms": round(_percentile(lats, 0.99) * 1e3, 2),
+        "mean_ms": round(sum(lats) / n * 1e3, 2),
+    }
+
+
+# -- in-process closed loop (gateway capacity) --------------------------------
+
+
+def run_inprocess(gateway, *, request_rows: int, feature_dim: int,
+                  clients: int, duration: float) -> dict:
+    import numpy as np
+
+    rows = [np.arange(feature_dim, dtype=np.float32) + i
+            for i in range(request_rows)]
+    per_client: list[list[float]] = [[] for _ in range(clients)]
+    errors: list[str] = []
+
+    def _loop(mine: list[float]) -> None:
+        try:
+            deadline = time.perf_counter() + duration
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                gateway.predict(rows, timeout=30.0)
+                mine.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=_loop, args=(per_client[i],))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"bench client failed: {errors[0]}")
+    return _stats([x for lane in per_client for x in lane], elapsed,
+                  request_rows, clients, "inprocess")
+
+
+# -- TCP closed loop (client processes) ---------------------------------------
+
+
+def _closed_loop(endpoint, authkey, request_rows: int, feature_dim: int,
+                 duration: float, latencies: list[float],
+                 errors: list[str]) -> None:
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import GatewayClient
+
+    rows = [np.arange(feature_dim, dtype=np.float32) + i
+            for i in range(request_rows)]
+    client = GatewayClient(endpoint[0], endpoint[1], authkey)
+    mine: list[float] = []
+    try:
+        deadline = time.perf_counter() + duration
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            out = client.predict(rows, timeout=30.0)
+            mine.append(time.perf_counter() - t0)
+            if len(out) != request_rows:
+                errors.append(f"short reply: {len(out)}/{request_rows}")
+                return
+    except Exception as e:  # noqa: BLE001 - surfaced by the caller
+        errors.append(f"{type(e).__name__}: {e}")
+    finally:
+        latencies.extend(mine)  # one append per client: no lock needed
+        try:
+            client.close()
+        except OSError:  # toslint: allow-silent(bench teardown; the gateway may already be closing)
+            pass
+
+
+def _client_proc_main(conn, endpoint, authkey, request_rows: int,
+                      feature_dim: int, conns: int, duration: float) -> None:
+    """Child process: ``conns`` closed-loop connections, latencies piped
+    back.  TCP clients live OUTSIDE the driver process — in-process client
+    threads would share the gateway's GIL, so the wire numbers would
+    measure the interpreter, not the endpoint."""
+    per_conn: list[list[float]] = [[] for _ in range(conns)]
+    errors: list[str] = []
+    threads = [
+        threading.Thread(target=_closed_loop,
+                         args=(endpoint, authkey, request_rows, feature_dim,
+                               duration, per_conn[i], errors))
+        for i in range(conns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conn.send(([x for lane in per_conn for x in lane], errors))
+
+
+def run_tcp(cluster, gateway, *, request_rows: int, feature_dim: int,
+            client_procs: int, conns_per_proc: int, duration: float) -> dict:
+    """One closed-loop run against the gateway's TCP endpoint."""
+    ctx = mp.get_context("fork")
+    procs, pipes = [], []
+    for _ in range(client_procs):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_client_proc_main,
+                        args=(child, gateway.endpoint, cluster.authkey,
+                              request_rows, feature_dim, conns_per_proc,
+                              duration),
+                        daemon=True)
+        p.start()
+        procs.append(p)
+        pipes.append(parent)
+    t0 = time.perf_counter()
+    outs = [pipe.recv() for pipe in pipes]
+    elapsed = time.perf_counter() - t0
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    errors = [e for _, errs in outs for e in errs]
+    if errors:
+        raise RuntimeError(f"bench client failed: {errors[0]}")
+    return _stats([x for lane, _ in outs for x in lane], elapsed,
+                  request_rows, client_procs * conns_per_proc, "tcp")
+
+
+def bench(quick: bool = False, *, max_batch: int = 64,
+          num_nodes: int = 2) -> dict:
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import linear as linmod
+
+    feature_dim = 16
+    duration = 2.0 if quick else 8.0
+    config = {"model": "linear", "in_dim": feature_dim,
+              "out_dim": feature_dim}
+    results: dict = {"max_batch": max_batch, "num_nodes": num_nodes,
+                     "configs": {}}
+    with tempfile.TemporaryDirectory() as tmp:
+        export = os.path.join(tmp, "bundle")
+        export_bundle(export, linmod.init_params(config, scale=2.0), config)
+        cluster = tcluster.run(
+            serving.serving_loop,
+            {"export_dir": export, "max_batch": max_batch},
+            num_executors=num_nodes,
+            input_mode=tcluster.InputMode.STREAMING,
+            heartbeat_interval=1.0,
+            reservation_timeout=120.0,
+        )
+        try:
+            gateway = cluster.serve(export, max_batch=max_batch,
+                                    max_delay_ms=5.0, queue_limit=1024,
+                                    listen_host="127.0.0.1",
+                                    reload_poll_secs=0)
+            # warmup: compile both replicas' jitted apply outside the clock
+            run_inprocess(gateway, request_rows=max_batch,
+                          feature_dim=feature_dim, clients=num_nodes,
+                          duration=1.0)
+            results["configs"]["1row"] = run_inprocess(
+                gateway, request_rows=1, feature_dim=feature_dim,
+                clients=8 if quick else 24, duration=duration)
+            results["configs"]["1row_tcp"] = run_tcp(
+                cluster, gateway, request_rows=1, feature_dim=feature_dim,
+                client_procs=2, conns_per_proc=4 if quick else 16,
+                duration=duration)
+            results["configs"]["64row_tcp"] = run_tcp(
+                cluster, gateway, request_rows=max_batch,
+                feature_dim=feature_dim, client_procs=2,
+                conns_per_proc=1 if quick else 4, duration=duration)
+        finally:
+            cluster.shutdown(timeout=120.0)
+    return results
+
+
+def markdown_table(results: dict) -> str:
+    lines = [f"### serving gateway ({results['num_nodes']} nodes, "
+             f"max_batch={results['max_batch']}, loopback)",
+             "| config | transport | clients | qps | rows/s | p50 ms | "
+             "p99 ms | mean ms |",
+             "|---|---|---|---|---|---|---|---|"]
+    for label, r in results["configs"].items():
+        lines.append(
+            f"| {label} | {r['transport']} | {r['clients']} | "
+            f"{r['qps']:,.0f} | {r['rows_per_s']:,.0f} | {r['p50_ms']} | "
+            f"{r['p99_ms']} | {r['mean_ms']} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="short duration / few clients (smoke test)")
+    ap.add_argument("--json", default="",
+                    help="also write the raw results to this JSON file")
+    args = ap.parse_args(argv)
+    results = bench(quick=args.quick)
+    print(markdown_table(results))
+    one = results["configs"]["1row"]
+    gate = (one["qps"] >= 500.0
+            and one["p99_ms"] <= 5.0 * one["p50_ms"])
+    print(f"acceptance (1row: >=500 qps, p99 <= 5x p50): "
+          f"{'PASS' if gate else 'MISS'} "
+          f"({one['qps']} qps, p99/p50 = {one['p99_ms'] / one['p50_ms']:.2f})")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"raw results -> {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -238,6 +238,9 @@ class DialDisciplineChecker(Checker):
 _THREADED_BASENAMES = frozenset({
     "coordinator.py", "cluster.py", "dataserver.py", "supervisor.py",
     "node.py", "feeding.py",
+    # the online-serving subsystem is thread-per-replica + flush/watch
+    # threads throughout — same race classes, same discipline
+    "gateway.py", "batcher.py", "router.py",
 })
 _BLOCKING_NAMES = frozenset({
     "recv", "accept", "join", "sleep", "connect_with_backoff",

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 from typing import Any, Callable
 
 from tensorflowonspark_tpu.utils.paths import resolve_uri
@@ -413,13 +414,69 @@ def export_stablehlo(export_dir: str, params: Any, model_config: dict,
 
 
 _BUNDLE_CACHE: dict[str, tuple[Any, dict, Callable]] = {}
+_BUNDLE_LOCK = threading.Lock()
+# single-flight per export_dir: the loader-elect's event, waited on by every
+# concurrent caller of the same key so N serving threads cost ONE load
+_BUNDLE_LOADING: dict[str, threading.Event] = {}
+# per-key invalidation generation: a load that STARTED before an
+# invalidate_bundle call must not re-cache its (now stale) result after it
+_BUNDLE_GEN: dict[str, int] = {}
 
 
 def load_bundle_cached(export_dir: str, build_apply: Callable[[dict], Callable]) -> tuple[Any, dict, Callable]:
     """Per-process cached bundle load (reference ``pipeline._run_model``'s
-    per-executor singleton SavedModel load, ``pipeline.py:~600-700``)."""
+    per-executor singleton SavedModel load, ``pipeline.py:~600-700``).
+
+    Thread-safe with single-flight semantics: concurrent callers of the
+    same ``export_dir`` (the serving gateway's replica workers, a reload
+    racing a request) share ONE load — one thread loads while the rest
+    wait on its completion event, then read the cache.  A failed load
+    releases the key so the next caller retries rather than caching the
+    error.  ``invalidate_bundle`` is the hot-reload hook.
+    """
     key = os.path.abspath(resolve_uri(export_dir))
-    if key not in _BUNDLE_CACHE:
-        params, config = load_bundle(export_dir)
-        _BUNDLE_CACHE[key] = (params, config, build_apply(config))
-    return _BUNDLE_CACHE[key]
+    while True:
+        with _BUNDLE_LOCK:
+            hit = _BUNDLE_CACHE.get(key)
+            if hit is not None:
+                return hit
+            pending = _BUNDLE_LOADING.get(key)
+            if pending is None:
+                _BUNDLE_LOADING[key] = threading.Event()
+                gen = _BUNDLE_GEN.get(key, 0)
+        if pending is not None:
+            pending.wait()  # loader finished (or failed); re-check the cache
+            continue
+        try:
+            params, config = load_bundle(export_dir)
+            value = (params, config, build_apply(config))
+            with _BUNDLE_LOCK:
+                if _BUNDLE_GEN.get(key, 0) == gen:
+                    _BUNDLE_CACHE[key] = value
+                # else: invalidate_bundle ran while this load was reading the
+                # OLD export files — hand the stale value to THIS caller (it
+                # started before the swap) but never cache it, or the hot
+                # reload would be silently undone
+            return value
+        finally:
+            with _BUNDLE_LOCK:
+                done = _BUNDLE_LOADING.pop(key, None)
+            if done is not None:
+                done.set()
+
+
+def invalidate_bundle(export_dir: str | None = None) -> None:
+    """Drop cached bundle(s) so the next ``load_bundle_cached`` re-reads
+    from disk — the serving hot-reload hook (``serving_loop``'s reload
+    control round calls this before swapping in the newer export).
+    ``None`` clears the whole cache.  Also fences out loads already in
+    flight: their results are returned to their callers but not cached."""
+    with _BUNDLE_LOCK:
+        if export_dir is None:
+            _BUNDLE_CACHE.clear()
+            for key in _BUNDLE_LOADING:
+                _BUNDLE_GEN[key] = _BUNDLE_GEN.get(key, 0) + 1
+            return
+        key = os.path.abspath(resolve_uri(export_dir))
+        _BUNDLE_CACHE.pop(key, None)
+        _BUNDLE_GEN[key] = _BUNDLE_GEN.get(key, 0) + 1

@@ -322,6 +322,9 @@ class TPUCluster:
             self.supervisor = Supervisor(coordinator, launcher, policy)
         self._recovery_timeout = _env_float("TOS_RECOVERY_TIMEOUT", 90.0)
         self._max_feed_attempts = _env_int("TOS_MAX_PARTITION_ATTEMPTS", 3)
+        # Online serving gateways opened via serve(); closed at shutdown so
+        # their routers stop before the feed gets its EOFs.
+        self._gateways: list = []
         # Feed pump: one sender per node connection (the train/inference
         # worker threads), chunk sends pipelined per connection
         # (TOS_SEND_WINDOW in DataClient) and optionally capped fleet-wide
@@ -915,6 +918,32 @@ class TPUCluster:
             raise RuntimeError(f"inference worker failed after all results were "
                                f"collected: {errors[0]}") from errors[0]
 
+    # -- online serving (beyond-reference: request/response path) ------------
+
+    def serve(self, export_dir: str, **kwargs) -> Any:
+        """Open an online-serving gateway over this cluster's nodes.
+
+        The nodes must be running the resident ``serving.serving_loop``
+        map_fun (pass it to ``cluster.run`` with ``{"export_dir": ...}``
+        args); the returned :class:`~tensorflowonspark_tpu.serving.
+        ServingGateway` answers individual requests with dynamic
+        micro-batching, least-outstanding replica routing, and a TCP wire
+        endpoint — see ``serving/gateway.py``.  Run the cluster with
+        ``elastic=True`` so a replica death becomes a supervised restart
+        the gateway rides out (in-flight batches retry on a survivor)
+        instead of a job failure.
+
+        Keyword args pass through to ``ServingGateway`` (``max_batch``,
+        ``max_delay_ms``, ``queue_limit``, ``default_timeout``, ``listen``,
+        ``reload_poll_secs``, ...); the ``TOS_SERVE_*`` knobs supply
+        defaults.  The gateway closes automatically at ``shutdown()``.
+        """
+        from tensorflowonspark_tpu.serving import ServingGateway
+
+        gateway = ServingGateway(self, export_dir, **kwargs)
+        self._gateways.append(gateway)
+        return gateway
+
     # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
 
     def shutdown(self, grace_secs: float = 0.0, timeout: float | None = None) -> None:
@@ -936,6 +965,12 @@ class TPUCluster:
         self._monitor_stop.set()
         if self.supervisor is not None:
             self.supervisor.stop()
+        # Serving gateways first: their routers hold data-plane connections
+        # and must stop dispatching before EOF ends the serving_loops.
+        for gw in self._gateways:
+            with contextlib.suppress(Exception):
+                gw.close()
+        self._gateways = []
         try:
             # DIRECT-mode map_funs never consume the feed; EOF would just open
             # pointless connections to nodes that may already have exited.

@@ -164,6 +164,12 @@ class CoordinatorServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one connection, many requests
+                from tensorflowonspark_tpu.utils.net import set_nodelay
+
+                # request/reply stream of small JSON frames: with Nagle on,
+                # every barrier/reduce/heartbeat risks a ~40ms delayed-ACK
+                # stall (the client side already dials with nodelay)
+                set_nodelay(self.request)
                 if outer.authkey is not None:
                     from tensorflowonspark_tpu.utils.net import hmac_handshake_server
 

@@ -53,7 +53,7 @@ from tensorflowonspark_tpu.data import _MIN_OOB_ROW_BYTES as _MIN_OOB_BYTES
 from tensorflowonspark_tpu.data import pack_chunk as _pack_chunk
 from tensorflowonspark_tpu.data import unpack_items as _unpack_items
 from tensorflowonspark_tpu.feeding import FeedQueues
-from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, ResultChunk
 
 logger = logging.getLogger(__name__)
 
@@ -76,7 +76,17 @@ from tensorflowonspark_tpu.utils.net import (  # noqa: E402
     recv_exact as _recv_raw,
     recv_exact_into as _recv_into,
     sendmsg_all as _sendmsg_all,
+    set_nodelay as _set_nodelay,
 )
+
+
+def _extend_results(out: list, item: Any) -> None:
+    """Flatten a popped output-queue item into per-item results (a
+    ``ResultChunk`` carries a whole batch as one entry)."""
+    if isinstance(item, ResultChunk):
+        out.extend(item.items)
+    else:
+        out.append(item)
 
 
 def _force_put(q: queue.Queue, item: Any) -> None:
@@ -287,6 +297,7 @@ class DataServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            _set_nodelay(conn)  # request/reply stream: Nagle only adds 40ms
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
@@ -344,7 +355,7 @@ class DataServer:
             # wire-format negotiation: a client that gets an unknown-op error
             # back (old server) stays on v1; see WIRE_VERSION
             return ("ok", min(WIRE_VERSION, int(msg[1])))
-        if op in ("feed", "infer_send"):
+        if op in ("feed", "infer_send", "infer_round"):
             # may raise FaultInjected when a `sever` action is armed
             faultinject.data_op()
         if op == "feed":
@@ -429,17 +440,57 @@ class DataServer:
                 except queue.Full:  # toslint: allow-silent(bounded-hold protocol: end_placed=False in the reply makes the client retry the marker)
                     pass
             return ("ok", accepted, end_placed, "running")
+        if op == "infer_round":
+            # Serving hot path: ONE round-trip scores one whole micro-batch —
+            # feed the items + EndPartition, then hold the connection until
+            # the map_fun's results (usually one ResultChunk) are collected.
+            # The send/collect split (infer_send + collect polling) exists so
+            # BIG partitions never pin a connection; a serving batch is tiny
+            # and latency-bound, so here the round-trip count wins instead.
+            _, qname_in, qname_out, items, wait = msg
+            items = _unpack_items(items)
+            telemetry.counter("dataplane.chunks_in").inc()
+            telemetry.counter("dataplane.rows_in").inc(len(items))
+            if self.queues.get("state") == "terminating":
+                return ("ok", None, "terminating")
+            q = self.queues.get_queue(qname_in)
+            for item in (*items, EndPartition()):
+                state = self._put_responsive(q, item)
+                if state is not None:
+                    return (state if state[0] == "err"
+                            else ("ok", None, "terminating"))
+            qo = self.queues.get_queue(qname_out)
+            results: list = []
+            deadline = _monotonic() + min(float(wait), self.feed_timeout)
+            while len(results) < len(items):
+                if self.queues.get("state") == "terminating":
+                    return ("ok", None, "terminating")
+                remaining = deadline - _monotonic()
+                if remaining <= 0:
+                    return ("err", f"infer_round produced {len(results)}/"
+                                   f"{len(items)} results within {wait}s")
+                try:
+                    _extend_results(results,
+                                    qo.get(block=True,
+                                           timeout=min(0.5, remaining)))
+                except queue.Empty:  # toslint: allow-silent(bounded poll slice; the while loop re-checks state and deadline)
+                    pass
+            return ("ok", results, "running")
         if op == "collect":
             # Pop up to max_n inference results: block briefly for the first,
             # then drain whatever is already there.  Short by construction.
+            # A ResultChunk flattens to its per-item results (the serving
+            # loop ships each batch as one chunk; chunks never split across
+            # collects — each belongs wholly to the in-flight partition).
             _, qname, max_n, wait = msg
             qo = self.queues.get_queue(qname)
             results: list = []
             try:
-                results.append(qo.get(block=True,
-                                      timeout=min(float(wait), self.feed_timeout)))
+                _extend_results(results,
+                                qo.get(block=True,
+                                       timeout=min(float(wait), self.feed_timeout)))
                 while len(results) < int(max_n):
-                    results.append(qo.get_nowait())
+                    _extend_results(results, qo.get_nowait())
             except queue.Empty:  # toslint: allow-silent(collect drains what is already there; empty just ends this round-trip)
                 pass
             return ("ok", results)
@@ -905,6 +956,35 @@ class DataClient:
                     f"data plane error: inference produced {len(results)}/"
                     f"{len(items)} results before {self.stall_timeout}s stall timeout")
         return results
+
+    def infer_round(self, items: Iterable[Any], qname_in: str = "input",
+                    qname_out: str = "output",
+                    wait: float | None = None) -> list:
+        """Score one micro-batch in a SINGLE round-trip (serving hot path):
+        the server feeds the items, waits for the map_fun's results, and
+        the reply carries them — no separate collect polling.  Returns
+        exactly-count ordered results; raises when the node is terminating
+        or the round times out.  Requires a server with the ``infer_round``
+        op (this build); the chunked send/collect pair remains the right
+        tool for big batch partitions."""
+        items = list(items)
+        wait = self.stall_timeout if wait is None else wait
+        # no sender_gate permit: the round spans node COMPUTE, and the gate
+        # contract forbids holding a send permit across anything but a send
+        reply = self._call(("infer_round", qname_in, qname_out,
+                            self._pack_items(items), wait))
+        if len(reply) > 2 and reply[2] == "terminating":
+            raise RuntimeError(
+                "data plane error: node terminated mid-inference round")
+        return reply[1]
+
+    def collect_results(self, qname_out: str = "output", max_n: int = 64,
+                        wait: float = 2.0) -> list:
+        """Pop up to ``max_n`` already-available inference results (bounded
+        wait for the first; ResultChunks flattened).  The serving router's
+        re-admission resync drains abandoned-round leftovers with this."""
+        return list(self._call(("collect", qname_out, int(max_n),
+                                float(wait)))[1])
 
     def send_eof(self, qname: str = "input", timeout: float | None = None) -> None:
         """EOF is a teardown-path control message: the node replies within

@@ -33,7 +33,7 @@ import threading
 from typing import Any, Iterable, Sequence
 
 from tensorflowonspark_tpu import faultinject, telemetry
-from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
 
 
 class FeedQueues:
@@ -221,8 +221,16 @@ class DataFeed:
 
     # -- producing results (inference path) ----------------------------------
 
-    def batch_results(self, results: Iterable[Any]) -> None:
+    def batch_results(self, results: Iterable[Any], chunk: bool = False) -> None:
+        """Emit one result per input item.  ``chunk=True`` ships the whole
+        batch as a single :class:`ResultChunk` queue item — one put and one
+        ``collect`` round-trip instead of per-item queue traffic; the data
+        server flattens it transparently, so collectors see identical
+        per-item results either way (serving hot path)."""
         q = self.queues.get_queue(self.qname_out)
+        if chunk:
+            q.put(ResultChunk(results))
+            return
         for r in results:
             q.put(r)
 

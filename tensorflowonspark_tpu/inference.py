@@ -25,14 +25,37 @@ def _arg(args, name, default=None):
     return getattr(args, name, default)
 
 
+def _stack_feature_column(values: list) -> np.ndarray:
+    """Stack one column's rows, deciding the dtype PER COLUMN.
+
+    A column whose every row is a wide integer (>= 32-bit) keeps its
+    integer dtype — LM-style bundles feed token ids straight into
+    embedding lookups, and a silent float32 cast corrupts any id above
+    2**24.  Everything else — inexact rows, narrow integers (uint8
+    pixels: the cast is lossless below 2**24 and existing image pipelines
+    feed float32-compiled convs), or MIXED int/float rows (JSON-decoded
+    data where 0 and 0.5 decode to different types) — normalizes to
+    float32, the single-array contract the jitted apply fns compiled
+    against.  Deciding per column (not per row) is what keeps a mixed
+    column from promoting to float64 under numpy's stack rules.
+    """
+    arrays = [np.asarray(v) for v in values]
+    if all(a.dtype.kind in "iu" and a.dtype.itemsize >= 4 for a in arrays):
+        return np.stack(arrays)
+    return np.stack([a if a.dtype == np.float32 else a.astype(np.float32)
+                     for a in arrays])
+
+
 def rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
     """Stack mapped feature columns into one batch array.
 
     Row dicts with a multi-column ``input_mapping`` are concatenated on the
     trailing feature axis in mapping order (each column flattened to
-    ``[B, -1]`` first) — the single-array contract jitted apply fns expose.
-    A single mapped column keeps its natural shape (images stay ``[B,H,W,C]``).
-    Non-dict rows are stacked directly.
+    ``[B, -1]`` first) — the single-array contract jitted apply fns expose;
+    mixing integer and float columns there promotes via numpy's usual rules.
+    A single mapped column keeps its natural shape (images stay ``[B,H,W,C]``)
+    AND its wide-integer dtype (token ids stay ids — see
+    ``_stack_feature_column``).  Non-dict rows are stacked directly.
     """
     if isinstance(rows[0], dict):
         if input_mapping:
@@ -49,11 +72,19 @@ def rows_to_features(rows: list, input_mapping: dict | None) -> np.ndarray:
             raise ValueError(
                 f"cannot pick a feature column from {sorted(rows[0])}; set input_mapping"
             )
-        arrays = [np.stack([np.asarray(r[c], np.float32) for r in rows]) for c in cols]
+        arrays = [_stack_feature_column([r[c] for r in rows]) for c in cols]
         if len(arrays) == 1:
             return arrays[0]
-        return np.concatenate([a.reshape(a.shape[0], -1) for a in arrays], axis=-1)
-    return np.stack([np.asarray(r, np.float32) for r in rows])
+        # multi-column concatenation is a dense float feature matrix by
+        # construction (an id column flattened into it cannot feed an
+        # embedding anyway), so integer columns cast to float32 here —
+        # letting numpy promotion run would yield float64 batches the
+        # jitted apply fns never compiled for
+        return np.concatenate(
+            [(a if a.dtype == np.float32
+              else a.astype(np.float32)).reshape(a.shape[0], -1)
+             for a in arrays], axis=-1)
+    return _stack_feature_column(rows)
 
 
 def _local_rows(arr) -> np.ndarray:

@@ -40,3 +40,21 @@ class EndOfFeed(Marker):
     """No more data will ever arrive; consumers should finish up."""
 
     __slots__ = ()
+
+
+class ResultChunk(Marker):
+    """A whole batch of inference results as ONE output-queue item.
+
+    ``DataFeed.batch_results(..., chunk=True)`` wraps the batch in this and
+    the data server's ``collect`` op flattens it back out, so a 64-row
+    serving batch costs one queue put + one collect round-trip instead of
+    64 puts and several partial-drain round-trips (the serving gateway's
+    latency path).  Order within the chunk is result order, exactly-count
+    is preserved by construction (the chunk holds one result per input
+    row of its batch).
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = list(items)

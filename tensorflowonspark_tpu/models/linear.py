@@ -1,0 +1,46 @@
+"""Minimal dense model (``{"model": "linear"}``) — flax-free, instant to
+build and jit, used by the serving subsystem's tests and microbench where
+the model under the gateway must cost microseconds, not compiles.
+
+Params are a plain ``{"w": [in_dim, out_dim], "b": [out_dim]}`` tree, so a
+bundle re-export with scaled weights is a one-liner — exactly what the
+hot-reload tests need to observe a swap through changed predictions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tensorflowonspark_tpu.models.registry import register
+
+
+class Linear:
+    """`y = x @ w + b`; ``apply`` matches the registry's flax-style calling
+    convention (``model.apply({"params": tree}, x)``)."""
+
+    def __init__(self, config: dict):
+        self.in_dim = int(config.get("in_dim", 16))
+        self.out_dim = int(config.get("out_dim", self.in_dim))
+
+    def __call__(self, x):  # registry's signature probe only (no 'train' arg)
+        raise NotImplementedError("use model.apply(variables, x)")
+
+    def apply(self, variables, x):
+        p = variables["params"]
+        return x @ p["w"] + p["b"]
+
+
+@register("linear")
+def build_linear(config: dict) -> Linear:
+    return Linear(config)
+
+
+def init_params(config: dict, scale: float = 1.0) -> dict:
+    """Deterministic params: a (possibly rectangular) identity times
+    ``scale`` — predictions are analytically checkable (`y == scale * x`
+    when in_dim == out_dim), which the serving tests rely on."""
+    model = Linear(config)
+    return {
+        "w": (np.eye(model.in_dim, model.out_dim) * scale).astype(np.float32),
+        "b": np.zeros((model.out_dim,), np.float32),
+    }

@@ -28,7 +28,8 @@ def build(config: dict):
         # its own so one missing family doesn't skip the rest
         import importlib
 
-        for mod in ("mnist", "resnet", "inception", "wide_deep", "transformer"):
+        for mod in ("linear", "mnist", "resnet", "inception", "wide_deep",
+                    "transformer"):
             try:
                 importlib.import_module(f"tensorflowonspark_tpu.models.{mod}")
             except ImportError:

@@ -1,0 +1,31 @@
+"""tensorflowonspark_tpu.serving — low-latency online inference gateway.
+
+The request/response subsystem layered on the existing cluster (the batch
+stack's missing half — see ``gateway.py`` for the architecture):
+
+- :class:`ServingGateway` / :class:`GatewayClient` — driver-side handle
+  (``cluster.serve(export_dir)``) and the TCP wire caller;
+- :class:`MicroBatcher` — dynamic micro-batching + admission control;
+- :class:`ReplicaRouter` — least-outstanding routing, death retry,
+  incarnation-fenced recovery;
+- :func:`serving_loop` — the resident node map_fun.
+
+Tuning knobs: ``TOS_SERVE_QUEUE``, ``TOS_SERVE_MAX_BATCH``,
+``TOS_SERVE_MAX_DELAY_MS``, ``TOS_SERVE_TIMEOUT`` (see the README table).
+"""
+
+from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
+    MicroBatch,
+    MicroBatcher,
+    PendingPrediction,
+    ServeClosed,
+    ServeQueueFull,
+    ServeTimeout,
+)
+from tensorflowonspark_tpu.serving.gateway import (  # noqa: F401
+    CTL_KEY,
+    GatewayClient,
+    ServingGateway,
+)
+from tensorflowonspark_tpu.serving.loop import serving_loop  # noqa: F401
+from tensorflowonspark_tpu.serving.router import ReplicaRouter  # noqa: F401

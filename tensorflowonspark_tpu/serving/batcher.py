@@ -1,0 +1,317 @@
+"""Dynamic micro-batching for the online serving gateway.
+
+The latency half of the serving subsystem (PAPERS.md: tf.data's lesson that
+deadline-driven batching turns a throughput engine into a latency one):
+individual predict requests are coalesced into device-sized batches —
+flushed the moment ``TOS_SERVE_MAX_BATCH`` rows are queued OR the oldest
+request has waited ``TOS_SERVE_MAX_DELAY_MS``, whichever comes first — and
+each batch is padded to exactly ``max_batch`` rows so the node's jitted
+apply sees ONE static batch shape and never recompiles.
+
+Admission control happens here too: the request queue is bounded
+(``TOS_SERVE_QUEUE``) and an arriving request that finds it full is
+rejected immediately with :class:`ServeQueueFull` (the 503 of this wire
+protocol) — a loaded gateway sheds load at the door instead of growing an
+unbounded latency tail.  Every request carries a deadline
+(``TOS_SERVE_TIMEOUT`` default); requests that expire while still queued
+are dropped before dispatch, and a late result for an expired waiter is
+discarded — each accepted request is answered exactly once, with either
+its results or one error.
+
+A request may carry several rows; rows scatter back to their waiter by
+position, and a request larger than ``max_batch`` simply spans batches.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from time import monotonic as _monotonic
+from typing import Any, Callable, Sequence
+
+from tensorflowonspark_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+#: Marker key for in-band control items on the serving stream (reload /
+#: ping); ``serving_loop`` answers each with a one-item ack, preserving the
+#: exactly-count invariant of the transport.  Lives here (the leaf serving
+#: module) so gateway, router, and loop can all import it cycle-free.
+CTL_KEY = "_tos_serve_ctl"
+
+
+class ServeClosed(RuntimeError):
+    """The gateway is shut down; no further requests are accepted."""
+
+
+class ServeQueueFull(RuntimeError):
+    """Admission control rejection: the bounded request queue is full
+    (the wire protocol's 503 — retry later or add replicas)."""
+
+
+class ServeTimeout(TimeoutError):
+    """The request's deadline expired before its results arrived."""
+
+
+class _Request:
+    """One predict call: rows in, results (or one error) out, exactly once."""
+
+    __slots__ = ("rows", "results", "remaining", "offset", "error",
+                 "event", "deadline", "t_submit", "dispatched_at")
+
+    def __init__(self, rows: list, deadline: float):
+        self.rows = rows
+        self.results: list = [None] * len(rows)
+        self.remaining = len(rows)
+        self.offset = 0              # rows already pulled into batches
+        self.error: Exception | None = None
+        self.event = threading.Event()
+        self.deadline = deadline
+        self.t_submit = _monotonic()
+        self.dispatched_at: float | None = None
+
+
+class MicroBatch:
+    """One dispatchable unit: ``rows`` padded to the static batch shape,
+    ``n`` real rows, and the (request, request_offset, count, batch_offset)
+    entries that scatter results back to their waiters.  ``retries`` counts
+    re-dispatches after a replica failure (the router allows one)."""
+
+    __slots__ = ("rows", "n", "entries", "retries", "created_at")
+
+    def __init__(self, rows: list, n: int,
+                 entries: list[tuple[_Request, int, int, int]]):
+        self.rows = rows
+        self.n = n
+        self.entries = entries
+        self.retries = 0
+        self.created_at = _monotonic()
+
+
+class PendingPrediction:
+    """Async handle returned by ``predict_async``: ``result()`` blocks until
+    the request's deadline and returns its results or raises its error."""
+
+    def __init__(self, batcher: "MicroBatcher", request: _Request):
+        self._batcher = batcher
+        self._request = request
+
+    def done(self) -> bool:
+        return self._request.event.is_set()
+
+    def result(self) -> list:
+        return self._batcher.await_request(self._request)
+
+
+class MicroBatcher:
+    """Bounded request queue + the coalescing flush loop.
+
+    ``dispatch`` (the router's ``submit``) receives each built
+    :class:`MicroBatch`; ``pause_fn`` returning True holds flushes (the
+    gateway raises it while a hot reload drains in-flight batches —
+    requests keep queuing under the same admission bound meanwhile).
+
+    ``capacity_fn`` makes the flush *capacity-aware*: a ripe partial batch
+    is only dispatched while a replica can start it soon (the router's
+    ``has_capacity``).  When every replica is already busy, flushing would
+    just park a tiny batch in a replica queue — so the batcher keeps
+    coalescing instead, and the arrivals that land during the in-flight
+    round ride the next batch for free.  Measured on the 2-core bench box
+    this is the difference between ~1-row fills convoying behind each
+    other (95 qps, p50 296ms at 32 clients) and full-fill batches
+    (~3400 qps, p50 9ms).
+    """
+
+    def __init__(self, dispatch: Callable[[MicroBatch], None], *,
+                 max_batch: int, max_delay_secs: float, queue_limit: int,
+                 pause_fn: Callable[[], bool] | None = None,
+                 capacity_fn: Callable[[], bool] | None = None):
+        self.max_batch = max(1, int(max_batch))
+        self.max_delay = max(0.0, float(max_delay_secs))
+        self.queue_limit = max(1, int(queue_limit))
+        self._dispatch = dispatch
+        self._pause_fn = pause_fn or (lambda: False)
+        self._capacity_fn = capacity_fn or (lambda: True)
+        self._cond = threading.Condition()
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._rows_queued = 0
+        self._closed = False
+        self._depth = telemetry.gauge("serve.queue_depth")
+        self._thread = threading.Thread(target=self._flush_loop, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, rows: Sequence[Any], deadline: float) -> _Request:
+        """Admit one request or fast-fail; never blocks on a full queue."""
+        rows = list(rows)
+        if not rows:
+            raise ValueError("predict needs at least one row")
+        with self._cond:
+            if self._closed:
+                raise ServeClosed("serving gateway is closed")
+            if len(self._queue) >= self.queue_limit:
+                telemetry.counter("serve.rejected_total").inc()
+                raise ServeQueueFull(
+                    f"request queue full ({self.queue_limit} queued); "
+                    "retry later or add replicas")
+            req = _Request(rows, deadline)
+            self._queue.append(req)
+            self._rows_queued += len(rows)
+            self._depth.set(len(self._queue))
+            self._cond.notify_all()
+        telemetry.counter("serve.requests_total").inc()
+        telemetry.counter("serve.rows_total").inc(len(rows))
+        return req
+
+    def await_request(self, req: _Request) -> list:
+        """Block until the request resolves or its deadline passes; returns
+        results or raises the request's single error."""
+        if not req.event.wait(max(0.0, req.deadline - _monotonic())):
+            self._expire(req)
+            req.event.wait()  # _expire (or a racing completion) resolved it
+        if req.error is not None:
+            raise req.error
+        return req.results
+
+    def _expire(self, req: _Request) -> None:
+        with self._cond:
+            if req.event.is_set():
+                return  # completion won the race
+            try:
+                self._queue.remove(req)
+                self._rows_queued -= len(req.rows) - req.offset
+                self._depth.set(len(self._queue))
+            except ValueError:  # toslint: allow-silent(already pulled into an in-flight batch; the late results are discarded below)
+                pass
+            telemetry.counter("serve.expired_total").inc()
+            self._finish_locked(req, ServeTimeout(
+                f"request deadline expired after "
+                f"{_monotonic() - req.t_submit:.3f}s"))
+
+    # -- flush loop ----------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        while True:
+            batch: MicroBatch | None = None
+            with self._cond:
+                while not self._closed:
+                    self._drop_expired_locked()
+                    if self._queue and not self._pause_fn():
+                        age = _monotonic() - self._queue[0].t_submit
+                        ripe = (self._rows_queued >= self.max_batch
+                                or age >= self.max_delay)
+                        if ripe and self._capacity_fn():
+                            batch = self._build_batch_locked()
+                            break
+                        # ripe but no downstream capacity: hold — completion
+                        # notifies this cond, and every arrival meanwhile
+                        # raises the eventual batch's fill
+                        self._cond.wait(0.05 if ripe
+                                        else min(self.max_delay - age, 0.05))
+                    else:
+                        self._cond.wait(0.05)
+                if batch is None:
+                    return  # closed; close() already resolved the queue
+            self._dispatch(batch)
+
+    def _drop_expired_locked(self) -> None:
+        now = _monotonic()
+        expired = [r for r in self._queue if r.deadline <= now]
+        for req in expired:
+            self._queue.remove(req)
+            self._rows_queued -= len(req.rows) - req.offset
+            telemetry.counter("serve.expired_total").inc()
+            self._finish_locked(req, ServeTimeout(
+                "request deadline expired while queued"))
+        if expired:
+            self._depth.set(len(self._queue))
+
+    def _build_batch_locked(self) -> MicroBatch:
+        rows: list = []
+        entries: list[tuple[_Request, int, int, int]] = []
+        now = _monotonic()
+        while self._queue and len(rows) < self.max_batch:
+            req = self._queue[0]
+            if req.event.is_set():
+                # already resolved (expired, or an earlier slice's batch
+                # failed): its queued tail must not reach a replica or keep
+                # occupying an admission slot
+                self._queue.popleft()
+                self._rows_queued -= len(req.rows) - req.offset
+                continue
+            take = min(len(req.rows) - req.offset, self.max_batch - len(rows))
+            entries.append((req, req.offset, take, len(rows)))
+            rows.extend(req.rows[req.offset:req.offset + take])
+            if req.dispatched_at is None:
+                req.dispatched_at = now
+                telemetry.histogram("serve.queue_wait_secs").observe(
+                    now - req.t_submit)
+            req.offset += take
+            if req.offset >= len(req.rows):
+                self._queue.popleft()
+        n = len(rows)
+        self._rows_queued -= n
+        self._depth.set(len(self._queue))
+        telemetry.counter("serve.batches_total").inc()
+        telemetry.histogram("serve.batch_fill").observe(n / self.max_batch)
+        # pad to the static batch shape: the jitted apply compiles once
+        rows.extend(rows[-1] for _ in range(self.max_batch - n))
+        return MicroBatch(rows, n, entries)
+
+    # -- completion (router threads) -----------------------------------------
+
+    def complete_batch(self, batch: MicroBatch, results: list) -> None:
+        """Scatter one batch's results back to each waiter (positional)."""
+        with self._cond:
+            for req, roff, cnt, boff in batch.entries:
+                if req.event.is_set():
+                    continue  # expired/errored while the batch was in flight
+                req.results[roff:roff + cnt] = results[boff:boff + cnt]
+                req.remaining -= cnt
+                if req.remaining <= 0:
+                    self._finish_locked(req, None)
+            self._cond.notify_all()  # capacity freed: the flush loop may act
+
+    def fail_batch(self, batch: MicroBatch, error: Exception) -> None:
+        """Resolve every waiter of a failed batch with one error.  A
+        spanning request whose later rows are still queued is pulled out —
+        one error answers the whole request, and scoring its tail would be
+        wasted replica work charged against the admission bound."""
+        with self._cond:
+            for req, _roff, _cnt, _boff in batch.entries:
+                if not req.event.is_set():
+                    self._finish_locked(req, error)
+                    if req.offset < len(req.rows):
+                        try:
+                            self._queue.remove(req)
+                            self._rows_queued -= len(req.rows) - req.offset
+                        except ValueError:  # toslint: allow-silent(tail already pulled into another in-flight batch; complete/fail will skip the set event)
+                            pass
+            self._depth.set(len(self._queue))
+            self._cond.notify_all()
+
+    def _finish_locked(self, req: _Request, error: Exception | None) -> None:
+        req.error = error
+        if error is None:
+            telemetry.histogram("serve.request_secs").observe(
+                _monotonic() - req.t_submit)
+        req.event.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            err = ServeClosed("serving gateway closed with the request queued")
+            for req in self._queue:
+                self._finish_locked(req, err)
+            self._queue.clear()
+            self._rows_queued = 0
+            self._depth.set(0)
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)

@@ -1,0 +1,351 @@
+"""Driver-side online serving gateway: request/response over a live cluster.
+
+The reference stack only ever scored data as Spark partitions — a batch
+path (PAPER.md §3.3).  This gateway adds the missing request/response
+path: ``cluster.serve(export_dir)`` returns a handle whose ``predict`` /
+``predict_async`` answer individual requests with micro-batched, replica-
+routed inference over the SAME resident nodes, data plane, telemetry, and
+elastic machinery the batch path uses.
+
+Three layers, composed here:
+
+- admission + coalescing: :class:`~.batcher.MicroBatcher` (bounded queue
+  ``TOS_SERVE_QUEUE``, fast-fail rejection, per-request deadlines
+  ``TOS_SERVE_TIMEOUT``, flush at ``TOS_SERVE_MAX_BATCH`` rows or
+  ``TOS_SERVE_MAX_DELAY_MS``);
+- routing + failover: :class:`~.router.ReplicaRouter` (least-outstanding
+  replica choice, one retry on a live replica after a death, incarnation-
+  fenced recovery);
+- the wire endpoint: a threaded TCP frontend speaking the data plane's
+  own framing — HMAC handshake on the cluster authkey, then protocol-5
+  zero-copy v2 frames (numpy rows/results travel as out-of-band buffers).
+  :class:`GatewayClient` is the matching remote caller.
+
+Hot reload: a version watcher polls ``export_dir``; when a newer export
+lands, in-flight batches drain, every replica swaps its bundle via a
+control round (``serving_loop`` + ``checkpoint.invalidate_bundle``), and
+dispatch resumes — requests keep queuing during the swap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import socket
+import threading
+from time import monotonic as _monotonic
+from typing import Any, Sequence
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.dataserver import _recv, _send
+from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401 - CTL_KEY re-exported
+    CTL_KEY,
+    MicroBatcher,
+    PendingPrediction,
+    ServeClosed,
+    ServeQueueFull,
+    ServeTimeout,
+)
+from tensorflowonspark_tpu.serving.router import ReplicaRouter
+from tensorflowonspark_tpu.utils.envtune import env_float, env_int
+from tensorflowonspark_tpu.utils.net import (
+    bound_socket,
+    connect_with_backoff,
+    hmac_handshake_client,
+    hmac_handshake_server,
+    local_ip,
+    set_nodelay,
+)
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+logger = logging.getLogger(__name__)
+
+_ERR_TYPES = {"unavailable": ServeQueueFull, "deadline": ServeTimeout,
+              "closed": ServeClosed}
+
+
+class ServingGateway:
+    """Handle returned by ``cluster.serve(export_dir, ...)``.
+
+    ``predict(rows, timeout)`` blocks for one request; ``predict_async``
+    returns a :class:`~.batcher.PendingPrediction`.  ``endpoint`` is the
+    TCP frontend's ``(host, port)`` for :class:`GatewayClient` callers.
+    """
+
+    def __init__(self, cluster, export_dir: str, *,
+                 qname_in: str = "input", qname_out: str = "output",
+                 max_batch: int | None = None,
+                 max_delay_ms: float | None = None,
+                 queue_limit: int | None = None,
+                 default_timeout: float | None = None,
+                 listen: bool = True, listen_host: str = "",
+                 reload_poll_secs: float = 2.0):
+        self.export_dir = export_dir
+        self.max_batch = (int(max_batch) if max_batch is not None
+                          else env_int("TOS_SERVE_MAX_BATCH", 64))
+        delay_ms = (float(max_delay_ms) if max_delay_ms is not None
+                    else env_float("TOS_SERVE_MAX_DELAY_MS", 5.0))
+        self.queue_limit = (int(queue_limit) if queue_limit is not None
+                            else env_int("TOS_SERVE_QUEUE", 256))
+        self.default_timeout = (float(default_timeout)
+                                if default_timeout is not None
+                                else env_float("TOS_SERVE_TIMEOUT", 30.0))
+        if self.max_batch < 1 or self.queue_limit < 1:
+            raise ValueError("max_batch and queue_limit must be >= 1")
+        if delay_ms < 0 or self.default_timeout <= 0:
+            raise ValueError("max_delay_ms must be >= 0 and default_timeout "
+                             "> 0")
+        self._authkey = cluster.authkey
+        self._closed = False
+        self._reloading = False
+        self._reload_lock = threading.Lock()
+        self._router = ReplicaRouter(cluster, None,  # batcher set just below
+                                     qname_in=qname_in, qname_out=qname_out,
+                                     request_timeout=self.default_timeout)
+        self._batcher = MicroBatcher(
+            self._router.submit, max_batch=self.max_batch,
+            max_delay_secs=delay_ms / 1e3, queue_limit=self.queue_limit,
+            pause_fn=lambda: self._reloading,
+            capacity_fn=self._router.has_capacity)
+        self._router._batcher = self._batcher
+        # version watch: swap in a newer export, draining in-flight first
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
+        if reload_poll_secs and reload_poll_secs > 0:
+            self._export_sig = self._export_signature()
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, args=(float(reload_poll_secs),),
+                daemon=True, name="serve-version-watch")
+            self._watch_thread.start()
+        # TCP frontend (the wire endpoint).  Default listen_host="" binds
+        # ALL interfaces — remote callers are the point, and every
+        # connection must pass the HMAC handshake on the cluster authkey;
+        # pass listen_host="127.0.0.1" to confine it to loopback.
+        self._listener: socket.socket | None = None
+        self._endpoint: tuple[str, int] | None = None
+        if listen:
+            self._listener = bound_socket(listen_host)
+            port = self._listener.getsockname()[1]
+            self._endpoint = (listen_host or local_ip() or "127.0.0.1", port)
+            threading.Thread(target=self._accept_loop, daemon=True,
+                             name="serve-frontend").start()
+        logger.info("serving gateway up: %d replica(s), max_batch=%d, "
+                    "max_delay=%.1fms, queue=%d%s",
+                    len(cluster._feed_ids), self.max_batch, delay_ms,
+                    self.queue_limit,
+                    f", endpoint={self._endpoint}" if self._endpoint else "")
+
+    # -- request API ---------------------------------------------------------
+
+    @property
+    def endpoint(self) -> tuple[str, int] | None:
+        """(host, port) of the TCP frontend (None when ``listen=False``)."""
+        return self._endpoint
+
+    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+        """Score ``rows``; returns one result per row, in order.
+
+        Raises :class:`ServeQueueFull` when admission control rejects the
+        request (queue full — the 503), :class:`ServeTimeout` when the
+        deadline (``timeout``, default ``TOS_SERVE_TIMEOUT``) expires first,
+        and :class:`ServeClosed` after shutdown.
+        """
+        return self.predict_async(rows, timeout).result()
+
+    def predict_async(self, rows: Sequence[Any],
+                      timeout: float | None = None) -> PendingPrediction:
+        """Admit one request and return immediately; ``result()`` blocks."""
+        deadline = _monotonic() + (timeout if timeout is not None
+                                   else self.default_timeout)
+        return PendingPrediction(self._batcher,
+                                 self._batcher.submit(rows, deadline))
+
+    def healthy_replicas(self) -> list[int]:
+        return self._router.healthy_replicas()
+
+    # -- hot reload ----------------------------------------------------------
+
+    def reload(self) -> dict[int, Any]:
+        """Swap every replica onto the bundle currently in ``export_dir``:
+        pause dispatch, drain in-flight batches, round-trip the reload
+        control item through each replica, resume.  Returns per-replica
+        acks.  Called automatically by the version watcher; safe to call
+        by hand after an in-place re-export."""
+        with self._reload_lock:
+            self._reloading = True
+            try:
+                self._router.drain()
+                acks = self._router.broadcast_ctl(
+                    {CTL_KEY: "reload", "export_dir": self.export_dir})
+                telemetry.counter("serve.reloads_total").inc()
+                logger.info("serving bundle reloaded on replicas %s",
+                            sorted(acks))
+                return acks
+            finally:
+                self._reloading = False
+
+    def _export_signature(self) -> tuple:
+        """Cheap change signature of the export: (name, mtime_ns, size) of
+        the bundle files.  ``export_bundle`` commits params.npz by atomic
+        rename, so a changed signature is a complete newer export."""
+        local = resolve_uri(self.export_dir)
+        sig = []
+        for name in ("bundle.json", "params.npz", "params"):
+            try:
+                st = os.stat(os.path.join(local, name))
+            except OSError:
+                continue
+            sig.append((name, st.st_mtime_ns, st.st_size))
+        return tuple(sig)
+
+    def _watch_loop(self, poll: float) -> None:
+        while not self._watch_stop.wait(poll):
+            try:
+                cur = self._export_signature()
+            except Exception:  # noqa: BLE001 - transient fs hiccup
+                logger.debug("export version check failed", exc_info=True)
+                continue
+            if cur and cur != self._export_sig:
+                logger.info("newer export detected in %s; hot-reloading",
+                            self.export_dir)
+                try:
+                    self.reload()
+                except Exception:  # noqa: BLE001 - keep serving the old bundle
+                    # signature NOT advanced: the next poll retries the swap
+                    # instead of pinning the stale bundle forever
+                    logger.warning("hot reload failed; still serving the "
+                                   "previous bundle (will retry)",
+                                   exc_info=True)
+                else:
+                    self._export_sig = cur
+
+    # -- TCP frontend --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            set_nodelay(conn)  # small request/reply frames: Nagle adds ~40ms
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="serve-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            if not hmac_handshake_server(conn, self._authkey):
+                logger.warning("rejected gateway connection: bad authkey")
+                return
+            while True:
+                msg = _recv(conn)
+                reply = self._handle(msg)
+                _send(conn, reply, wire=2)
+                if msg[0] == "close":
+                    return
+        except (ConnectionError, OSError, EOFError):
+            return
+        finally:
+            conn.close()
+
+    def _handle(self, msg: tuple) -> tuple:
+        op = msg[0]
+        if op == "predict":
+            rows, timeout = msg[1], (msg[2] if len(msg) > 2 else None)
+            try:
+                return ("ok", self.predict(list(rows), timeout))
+            except ServeQueueFull as e:
+                return ("err", "unavailable", str(e))
+            except ServeTimeout as e:
+                return ("err", "deadline", str(e))
+            except ServeClosed as e:
+                return ("err", "closed", str(e))
+            except Exception as e:  # noqa: BLE001 - surface to the caller
+                logger.exception("gateway predict failed")
+                return ("err", "internal", f"{type(e).__name__}: {e}")
+        if op == "ping":
+            return ("ok", "pong")
+        if op == "close":
+            return ("ok",)
+        return ("err", "internal", f"unknown op {op!r}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop accepting, fail queued requests, tear the layers down.
+        Called automatically by ``cluster.shutdown()``."""
+        if self._closed:
+            return
+        self._closed = True
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10.0)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:  # toslint: allow-silent(closing the listener is what stops the accept loop; a racing second close is fine)
+                pass
+        self._router.close()
+        self._batcher.close()
+
+
+class GatewayClient:
+    """Remote caller for a gateway's TCP endpoint.
+
+    Same wire stack as the data plane: HMAC challenge-response on the
+    cluster authkey, then v2 (protocol-5, zero-copy) frames.  One
+    request/reply in flight per connection — open one client per
+    closed-loop caller (the bench does), or several for pipelining.
+    """
+
+    def __init__(self, host: str, port: int, authkey: bytes, *,
+                 connect_timeout: float = 30.0, call_timeout: float = 120.0):
+        self._sock = connect_with_backoff((host, port),
+                                          timeout=connect_timeout)
+        self._sock.settimeout(call_timeout)
+        if not hmac_handshake_client(self._sock, authkey):
+            self._sock.close()
+            raise RuntimeError("gateway auth handshake failed")
+        # request/reply serializer (same deliberate hold-lock-across-I/O
+        # pattern as DataClient._call; baselined in analysis/baseline.json)
+        self._lock = threading.Lock()
+
+    def _call(self, msg: tuple):
+        with self._lock:
+            try:
+                _send(self._sock, msg, wire=2)
+                return _recv(self._sock)
+            except (TimeoutError, OSError):
+                # the stream may hold a partial frame or a late reply; a
+                # retry on it would read the PREVIOUS request's answer as
+                # its own — poison the socket (mirror of DataClient._call)
+                with contextlib.suppress(OSError):
+                    self._sock.close()
+                raise
+
+    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+        """Round-trip one predict request; mirrors ``ServingGateway.predict``
+        including its error types."""
+        reply = self._call(("predict", list(rows), timeout))
+        if isinstance(reply, tuple) and reply and reply[0] == "ok":
+            return reply[1]
+        if isinstance(reply, tuple) and len(reply) >= 3 and reply[0] == "err":
+            raise _ERR_TYPES.get(reply[1], RuntimeError)(reply[2])
+        raise RuntimeError(f"malformed gateway reply: {reply!r}")
+
+    def ping(self) -> bool:
+        reply = self._call(("ping",))
+        return bool(isinstance(reply, tuple) and reply and reply[0] == "ok")
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                _send(self._sock, ("close",), wire=2)
+                try:
+                    _recv(self._sock)
+                except (ConnectionError, OSError, EOFError):  # toslint: allow-silent(best-effort close ack; the gateway may already be gone)
+                    pass
+        except OSError:  # toslint: allow-silent(best-effort teardown; socket close below is what matters)
+            pass
+        finally:
+            self._sock.close()

@@ -1,0 +1,104 @@
+"""Node-side resident serving map_fun.
+
+Each serving replica runs this loop: load the bundle once (through the
+process-wide single-flight cache), then answer micro-batches streamed in by
+the gateway's router over the ordinary data plane — one ``infer_partition``
+round per batch, one result per input row, in order.
+
+Latency properties:
+
+- the gateway pads every batch to the static ``max_batch`` shape, so the
+  jitted apply compiles exactly once and never recompiles on partial
+  batches (the same pad-and-slice trick ``bundle_inference_loop`` uses);
+- control items (``{CTL_KEY: "reload"}``) ride the same stream as one-item
+  rounds and are acked with a one-item result, so the exactly-count
+  transport invariant holds for them too.  A ``reload`` invalidates the
+  bundle cache entry and reloads from ``export_dir`` — the node half of
+  the gateway's hot swap.
+
+Termination is the standard feed contract: EOF (cluster shutdown) or the
+driver's stop signal ends the loop; a supervised restart simply re-enters
+it, loading whatever bundle is newest on disk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def serving_loop(args, ctx) -> None:
+    """map_fun: serve gateway micro-batches with the bundle at
+    ``args.export_dir``.
+
+    Args: ``export_dir`` (required), ``max_batch`` (static batch shape;
+    default ``TOS_SERVE_MAX_BATCH`` — keep it equal to the gateway's),
+    ``postprocess`` ("argmax" for int class ids), ``input_mapping``
+    (row-dict column selection, see ``inference.rows_to_features``).
+    """
+    from tensorflowonspark_tpu.checkpoint import (
+        invalidate_bundle,
+        load_bundle_cached,
+    )
+    from tensorflowonspark_tpu.inference import _arg, rows_to_features
+    from tensorflowonspark_tpu.models.registry import build_apply
+    from tensorflowonspark_tpu.serving.batcher import CTL_KEY
+    from tensorflowonspark_tpu.utils.envtune import env_int
+
+    export_dir = _arg(args, "export_dir")
+    if not export_dir:
+        raise ValueError("serving_loop requires args.export_dir")
+    max_batch = (int(_arg(args, "max_batch", 0) or 0)
+                 or env_int("TOS_SERVE_MAX_BATCH", 64))
+    postprocess = _arg(args, "postprocess")
+    input_mapping = _arg(args, "input_mapping")
+
+    variables, _config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    batches = ctx.metrics.counter("serve.node_batches")
+    rows_served = ctx.metrics.counter("serve.node_rows")
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        items = feed.next_batch(max_batch)
+        if not items:
+            continue
+        if len(items) == 1 and isinstance(items[0], dict) and CTL_KEY in items[0]:
+            op = items[0][CTL_KEY]
+            if op == "reload":
+                invalidate_bundle(export_dir)
+                variables, _config, apply_fn = load_bundle_cached(
+                    export_dir, build_apply)
+                ctx.metrics.counter("serve.node_reloads").inc()
+                feed.batch_results([{CTL_KEY: "reloaded"}])
+            elif op == "ping":
+                # echo the nonce: the router's re-admission resync matches
+                # ITS pong (inputs are processed in order, so everything
+                # popped before it is provably stale) — see router._resync
+                feed.batch_results([{CTL_KEY: "pong",
+                                     "nonce": items[0].get("nonce")}])
+            else:
+                feed.batch_results([{CTL_KEY: f"unknown:{op}"}])
+            continue
+        n = len(items)
+        # gateway batches arrive pre-padded (len == max_batch); pad here too
+        # so direct infer_partition callers get the same single-compile apply
+        padded = list(items) + [items[-1]] * (max_batch - n)
+        with ctx.metrics.timed("serve.node_batch_secs"):
+            x = rows_to_features(padded, input_mapping)
+            out = apply_fn(variables, x)
+        if isinstance(out, dict):
+            if postprocess == "argmax":
+                raise ValueError("postprocess='argmax' needs a single-output "
+                                 "model; this bundle emits named outputs "
+                                 f"{sorted(out)}")
+            cols = {k: np.asarray(v)[:n] for k, v in out.items()}
+            results: list = [{k: v[i] for k, v in cols.items()}
+                             for i in range(n)]
+        else:
+            preds = np.asarray(out)[:n]
+            if postprocess == "argmax":
+                results = [int(p) for p in preds.argmax(axis=-1)]
+            else:
+                results = list(preds)
+        batches.inc()
+        rows_served.inc(n)
+        # one ResultChunk = one queue put + one collect round-trip per batch
+        feed.batch_results(results, chunk=True)

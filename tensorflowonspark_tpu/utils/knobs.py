@@ -96,6 +96,20 @@ _ALL = (
          "Cap on concurrent chunk SENDS across all node connections in "
          "train()/inference() (permit per chunk, never held across a "
          "partition); 0 = unlimited."),
+    Knob("TOS_SERVE_QUEUE", "int", "256",
+         "Serving gateway admission control: max queued (not yet "
+         "dispatched) predict requests before fast-fail rejection "
+         "(ServeQueueFull, the wire 'unavailable' error)."),
+    Knob("TOS_SERVE_MAX_BATCH", "int", "64",
+         "Serving micro-batcher: rows coalesced into one batch — also the "
+         "static batch shape every batch is padded to, so the node's "
+         "jitted apply compiles once."),
+    Knob("TOS_SERVE_MAX_DELAY_MS", "float", "5",
+         "Serving micro-batcher: max milliseconds the oldest queued "
+         "request waits for co-riders before a partial batch is flushed."),
+    Knob("TOS_SERVE_TIMEOUT", "float", "30",
+         "Default per-request deadline (seconds) for gateway predict "
+         "calls; expired requests are answered with ServeTimeout."),
     Knob("TOS_SHM_RING", "str", "(unset: measured probe decides)",
          "Same-host shared-memory ring for the data plane: 1 forces it on, "
          "0 forces TCP, unset lets a one-shot ring-vs-loopback probe pick "

@@ -1,0 +1,26 @@
+"""Tier-1 smoke for the committed serving microbench (ISSUE 5 satellite):
+one tiny in-process config must run end-to-end and produce sane stats —
+the guard that keeps ``bench_serving.py`` importable and runnable as the
+serving path evolves (numbers in BENCH_r07.json / PERF_NOTES round 8 come
+from the full run on an idle box)."""
+
+from __future__ import annotations
+
+
+def test_bench_serving_quick_config_runs(monkeypatch):
+    monkeypatch.setenv("TOS_SHM_RING", "0")
+    import bench_serving  # repo root is on sys.path via conftest
+
+    results = bench_serving.bench(quick=True)
+    assert results["max_batch"] == 64 and results["num_nodes"] == 2
+    for label in ("1row", "1row_tcp", "64row_tcp"):
+        r = results["configs"][label]
+        assert r["requests"] > 0
+        assert r["qps"] > 0
+        assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+        assert r["rows_per_s"] >= r["qps"]
+    assert results["configs"]["1row"]["transport"] == "inprocess"
+    assert results["configs"]["64row_tcp"]["request_rows"] == 64
+    # the table renderer stays in sync with the result schema
+    table = bench_serving.markdown_table(results)
+    assert "1row_tcp" in table and "qps" in table

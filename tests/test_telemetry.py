@@ -243,8 +243,12 @@ def test_fenced_zombie_metrics_are_dropped():
 # -- end-to-end: 2-node cluster aggregation + run report ----------------------
 
 
-def _poll_metrics(cluster, want_nodes, timeout=30.0):
-    """Wait until every wanted node key reported data-plane rows."""
+def _poll_metrics(cluster, want_nodes, want_rows=None, timeout=30.0):
+    """Wait until every wanted node key reported data-plane rows — and,
+    when ``want_rows`` is given, until the aggregate row count reaches it:
+    a node's counters ride the NEXT heartbeat after they move, so a
+    snapshot taken the moment a node first shows up can still be a stale
+    mid-train value (nonzero but not final)."""
     import time
 
     deadline = time.monotonic() + timeout
@@ -254,7 +258,9 @@ def _poll_metrics(cluster, want_nodes, timeout=30.0):
         nodes = snap.get("nodes", {})
         if all(nodes.get(k, {}).get("counters", {}).get("dataplane.rows_in")
                for k in want_nodes):
-            return snap
+            if (want_rows is None
+                    or snap["counters"].get("dataplane.rows_in") == want_rows):
+                return snap
         time.sleep(0.25)
     return snap
 
@@ -278,7 +284,7 @@ def test_cluster_metrics_aggregates_every_node_and_writes_run_report(tmp_path, m
         reservation_timeout=120.0,
     )
     cluster.train(parts, num_epochs=1)
-    snap = _poll_metrics(cluster, ("0", "1"))
+    snap = _poll_metrics(cluster, ("0", "1"), want_rows=len(items))
     for eid in ("0", "1"):
         counters = snap["nodes"][eid]["counters"]
         assert counters.get("dataplane.rx_bytes", 0) > 0, snap["nodes"]
