@@ -29,6 +29,7 @@ See SURVEY.md for the reference layer map this package mirrors.
 __version__ = "0.4.0"
 
 from tensorflowonspark_tpu import telemetry  # noqa: F401 - metrics/span API
+from tensorflowonspark_tpu import ingest  # noqa: F401 - DIRECT-mode reader pipeline
 from tensorflowonspark_tpu.cluster import InputMode, TPUCluster, run  # noqa: F401
 from tensorflowonspark_tpu.feeding import DataFeed  # noqa: F401
 from tensorflowonspark_tpu.launcher import (  # noqa: F401

@@ -1,6 +1,6 @@
 """The toslint checkers — this codebase's invariants, mechanically enforced.
 
-Six disciplines, each born from a class of bug the elastic control/data
+Seven disciplines, each born from a class of bug the elastic control/data
 plane makes likely (see ISSUE 2 / ROADMAP):
 
 - ``knob-discipline``: every ``TOS_*`` env read goes through
@@ -9,6 +9,9 @@ plane makes likely (see ISSUE 2 / ROADMAP):
 - ``dial-discipline``: no raw ``socket.create_connection`` outside
   ``utils/net.py`` — a single-shot dial turns every restart window into a
   hard failure; ``connect_with_backoff`` is the one sanctioned dial.
+- ``shard-io-discipline``: binary reads of record-shard files are confined
+  to ``tfrecord.py``/``ingest/`` — an ad-hoc ``open(shard, 'rb')`` skips
+  CRC verification and gzip detection.
 - ``lock-discipline``: in the threaded modules, attributes mutated both
   under and outside ``self._lock`` (a data race until proven otherwise),
   and blocking calls made while a lock is held (a convoy/deadlock seed).
@@ -233,6 +236,86 @@ class DialDisciplineChecker(Checker):
                         f"{_qual(scope)}@{name}")
 
 
+# -- 2b. shard IO discipline --------------------------------------------------
+
+# Record shards carry per-record CRCs and optional whole-stream gzip; the
+# ONLY readers that honour both live in tfrecord.py (read_records /
+# read_record_spans) and the ingest pipeline built on them.  An ad-hoc
+# `open(shard_path, "rb")` elsewhere silently skips CRC verification (and
+# misparses gzip shards), so binary opens of shard-looking paths are
+# confined.  Heuristic is lexical like the rest of toslint: the filename
+# expression's source text mentioning shard/tfrecord/part- is the signal.
+_SHARDISH_ARG = re.compile(r"shard|tfrecord|part-", re.IGNORECASE)
+_SHARD_OPEN_QUALS = frozenset({"open", "io.open", "gzip.open"})
+
+
+@register_checker
+class ShardIODisciplineChecker(Checker):
+    """Binary reads of record-shard files are confined to tfrecord.py and
+    ingest/ — everything else must go through the verifying codecs."""
+
+    id = "shard-io-discipline"
+    hint = ("read shards via tfrecord.read_records/read_record_spans (or "
+            "the ingest pipeline / dfutil.read_shard) — a raw open() "
+            "bypasses CRC verification and gzip detection")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if mod.path.endswith("tfrecord.py") or "/ingest/" in mod.path:
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fq = mod.imports.qualify(node.func)
+            name = fq if fq in _SHARD_OPEN_QUALS else None
+            if name is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "read_bytes":
+                # Path(...).read_bytes() — a binary read by construction
+                target_src = ast.unparse(node.func.value)
+                if _SHARDISH_ARG.search(target_src):
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"raw binary read of a record shard "
+                        f"({target_src}.read_bytes()) outside "
+                        "tfrecord.py/ingest/ skips CRC verification",
+                        self.hint, f"{_qual(scope)}@read_bytes")
+                continue
+            if name is None:
+                continue
+            if not self._is_binary_read(node, name):
+                continue
+            target = node.args[0] if node.args else None
+            target_src = ast.unparse(target) if target is not None else ""
+            if _SHARDISH_ARG.search(target_src):
+                yield Finding(
+                    self.id, mod.path, node.lineno,
+                    f"raw binary open of a record shard ({name}("
+                    f"{target_src}, ...)) outside tfrecord.py/ingest/ "
+                    "skips CRC verification",
+                    self.hint, f"{_qual(scope)}@{name}")
+
+    @staticmethod
+    def _is_binary_read(call: ast.Call, name: str) -> bool:
+        """True when the open() mode is a literal binary READ ('rb'...).
+        Dynamic (non-literal) modes stay quiet (can't judge without false
+        positives) — but an ABSENT mode on ``gzip.open`` counts: its
+        default is 'rb', exactly the CRC-bypassing read this checker
+        confines.  Writes are the writer's business (RecordWriter owns
+        shard writes, but e.g. benchmarks legitimately stage raw files)."""
+        mode_node = None
+        if len(call.args) >= 2:
+            mode_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+        if mode_node is None:
+            return name == "gzip.open"  # plain open() defaults to text 'r'
+        if not (isinstance(mode_node, ast.Constant)
+                and isinstance(mode_node.value, str)):
+            return False
+        mode = mode_node.value
+        return "b" in mode and not any(c in mode for c in "wax+")
+
+
 # -- 3. lock discipline / race heuristics ------------------------------------
 
 _THREADED_BASENAMES = frozenset({
@@ -241,6 +324,8 @@ _THREADED_BASENAMES = frozenset({
     # the online-serving subsystem is thread-per-replica + flush/watch
     # threads throughout — same race classes, same discipline
     "gateway.py", "batcher.py", "router.py",
+    # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer
+    "readers.py", "feed.py",
 })
 _BLOCKING_NAMES = frozenset({
     "recv", "accept", "join", "sleep", "connect_with_backoff",

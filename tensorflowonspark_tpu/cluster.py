@@ -50,9 +50,33 @@ logger = logging.getLogger(__name__)
 
 
 class InputMode(enum.Enum):
-    """Reference ``TFCluster.InputMode`` (``TFCluster.py:~40``)."""
+    """Reference ``TFCluster.InputMode`` (``TFCluster.py:~40``).
 
-    DIRECT = 0      # framework reads files itself (reference: TENSORFLOW)
+    What each mode supports (this table matches runtime behavior — every
+    mode-mismatch error names the mode that IS supported):
+
+    ========================  =======================  ======================
+    API                       DIRECT (≈ TENSORFLOW)    STREAMING (≈ SPARK)
+    ========================  =======================  ======================
+    ``train(data)``           ``data`` = shard path/   ``data`` = rows
+                              glob/dir; the ledger     (PartitionedDataset /
+                              feeds shard PATHS,       iterable); the driver
+                              nodes read the bytes     streams every row
+    ``ctx.get_data_feed()``   ``ingest.IngestFeed``    ``feeding.DataFeed``
+                              (node-side readers)      (driver-streamed)
+    ``inference()``           unsupported — use        supported (ordered,
+                              STREAMING, or score      exactly-count)
+                              via ``serve()``
+    ``serve()``               supported                supported
+    ========================  =======================  ======================
+
+    DIRECT map_funs may also ignore the feed entirely and read files
+    self-service (``dfutil.shard_files`` strided by ``ctx.executor_id`` —
+    the ``examples/mnist/mnist_tfr.py`` idiom); the ledger-driven path feed
+    is what adds at-least-once re-feed and elastic recovery on top.
+    """
+
+    DIRECT = 0      # nodes read sharded files themselves (reference: TENSORFLOW)
     STREAMING = 1   # driver streams partitions into node feeds (reference: SPARK)
 
     # Drop-in aliases for TensorFlowOnSpark users.
@@ -606,20 +630,74 @@ class TPUCluster:
     # -- training feed (reference TFCluster.train :~70-130, §3.2) ------------
 
     def train(self, data: Any, num_epochs: int = 1, qname: str = "input",
-              shuffle_seed: int | None = None) -> None:
-        """Stream partitions into the worker feeds (InputMode.STREAMING only).
-
-        Partition *i* goes to feedable node ``i % W`` — the same round-robin
-        partition placement Spark gave the reference.  Blocks until all
+              shuffle_seed: int | None = None,
+              num_partitions: int | None = None) -> None:
+        """Feed the workers for ``num_epochs`` epochs; blocks until all
         partitions are consumed (or nodes report 'terminating').
+
+        **STREAMING** (reference ``InputMode.SPARK``): ``data`` is the rows
+        themselves (a ``PartitionedDataset`` or any iterable of
+        partitions); the driver streams every row over the data plane.
+
+        **DIRECT** (reference ``InputMode.TENSORFLOW``): ``data`` is a
+        shard *directory, glob, file, or list of paths*
+        (``ingest.enumerate_shards``); the ledger feeds shard PATHS — tens
+        of bytes per shard — and each node's ingest pipeline reads, CRC-
+        verifies, and decodes the bytes itself (``ctx.get_data_feed`` →
+        ``ingest.IngestFeed``), so aggregate feed bandwidth scales with the
+        node count and the driver stays out of the training hot path.  One
+        shard per ledger partition by default (``num_partitions`` groups
+        them round-robin for many-tiny-file datasets).
+
+        Both modes share the SAME partition ledger: partition *i* homes on
+        feedable node ``i % W`` (the reference's round-robin placement),
+        delivery is at-least-once with the consumption watermark bounding
+        what a death can lose, and elastic restart recovery / incarnation
+        fencing apply unchanged — in DIRECT mode a dead node's unread
+        shards are simply re-assigned to a survivor or its replacement.
 
         ``shuffle_seed`` reorders partitions differently each epoch
         (seed+epoch, deterministic) — the between-epochs shuffle the
-        reference inherited from Spark/tf.data file shuffling.
+        reference inherited from Spark/tf.data file shuffling; in DIRECT
+        mode this is a between-epochs *shard* shuffle.
         """
-        if self.input_mode != InputMode.STREAMING:
-            raise RuntimeError("train(data) requires InputMode.STREAMING (reference: InputMode.SPARK)")
-        dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+        if self.input_mode == InputMode.DIRECT:
+            from tensorflowonspark_tpu.ingest import shards_as_partitioned
+
+            if not isinstance(data, (str, os.PathLike, list, tuple)) and not \
+                    hasattr(data, "iter_partition"):
+                raise RuntimeError(
+                    "InputMode.DIRECT (reference: InputMode.TENSORFLOW) "
+                    "train() takes a shard path/glob/directory (or list of "
+                    "paths), not row data — nodes read the files themselves. "
+                    "To stream rows from the driver, run the cluster with "
+                    "input_mode=InputMode.STREAMING (reference: InputMode.SPARK)")
+            if hasattr(data, "iter_partition"):
+                dataset = data  # pre-built partitions of paths: passthrough
+                num_shards = None
+            else:
+                from tensorflowonspark_tpu.ingest import enumerate_shards
+
+                files = enumerate_shards(data)
+                num_shards = len(files)
+                dataset = shards_as_partitioned(files, num_partitions)
+            self.coordinator.set_manifest({
+                "kind": "tfrecord_shards", "qname": qname,
+                "num_shards": num_shards,
+                "num_partitions": dataset.num_partitions,
+                "num_epochs": num_epochs,
+                "spec": str(data) if isinstance(data, (str, os.PathLike)) else None,
+            })
+        else:
+            if isinstance(data, (str, os.PathLike)):
+                raise RuntimeError(
+                    "train() got a path but this cluster runs "
+                    "InputMode.STREAMING (reference: InputMode.SPARK), which "
+                    "streams ROWS from the driver — pass the rows (e.g. "
+                    "dfutil.load_tfrecords(dir)[0]), or run the cluster with "
+                    "input_mode=InputMode.DIRECT (reference: "
+                    "InputMode.TENSORFLOW) for node-side shard ingestion")
+            dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
         # One view per epoch (identity, or the seeded between-epochs shuffle);
         # precomputed so a re-fed partition sees the same epoch ordering.
         views = [dataset if shuffle_seed is None
@@ -792,9 +870,13 @@ class TPUCluster:
         """
         if self.input_mode != InputMode.STREAMING:
             raise RuntimeError(
-                "inference requires InputMode.STREAMING (reference: InputMode.SPARK); "
-                "DIRECT-mode map_funs read files themselves and never consume the feed"
-            )
+                "inference()/inference_stream() require InputMode.STREAMING "
+                "(reference: InputMode.SPARK) — the exactly-count result "
+                "contract needs driver-streamed row partitions.  This "
+                "cluster runs InputMode.DIRECT (reference: "
+                "InputMode.TENSORFLOW), whose feed carries shard paths for "
+                "node-side ingestion; for request/response scoring on a "
+                "DIRECT cluster use cluster.serve(export_dir) instead")
         dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
         num_workers = len(self._feed_ids)
         if eof_when_done:
@@ -972,76 +1054,77 @@ class TPUCluster:
                 gw.close()
         self._gateways = []
         try:
-            # DIRECT-mode map_funs never consume the feed; EOF would just open
-            # pointless connections to nodes that may already have exited.
-            if self.input_mode == InputMode.STREAMING:
-                # executor_id is assigned in REGISTRATION order, not launch
-                # order — match processes through the launch_index each node
-                # reported at registration (pids can't do this: over ssh
-                # transports the local handle's pid is the ssh client).
-                procs = self.launcher.processes
-                id_to_proc = {
-                    m["executor_id"]: procs[m["launch_index"]]
-                    for m in self.cluster_info
-                    if 0 <= m.get("launch_index", -1) < len(procs)
-                }
-                for executor_id in self._feed_ids:
-                    proc = id_to_proc.get(executor_id)
-                    if proc is not None and not proc.is_alive():
-                        # node already finished and tore down its data plane;
-                        # an EOF would only block on a dead peer
-                        logger.debug("node %d already exited; skipping EOF",
-                                     executor_id)
-                        continue
-                    for qname in self.input_qnames:
+            # EOF goes to BOTH input modes: a DIRECT-mode IngestFeed
+            # consumes the path feed and its claimer winds down on
+            # EndOfFeed exactly like a streaming DataFeed (self-service
+            # DIRECT map_funs that never touch the feed leave it unread).
+            # executor_id is assigned in REGISTRATION order, not launch
+            # order — match processes through the launch_index each node
+            # reported at registration (pids can't do this: over ssh
+            # transports the local handle's pid is the ssh client).
+            procs = self.launcher.processes
+            id_to_proc = {
+                m["executor_id"]: procs[m["launch_index"]]
+                for m in self.cluster_info
+                if 0 <= m.get("launch_index", -1) < len(procs)
+            }
+            for executor_id in self._feed_ids:
+                proc = id_to_proc.get(executor_id)
+                if proc is not None and not proc.is_alive():
+                    # node already finished and tore down its data plane;
+                    # an EOF would only block on a dead peer
+                    logger.debug("node %d already exited; skipping EOF",
+                                 executor_id)
+                    continue
+                for qname in self.input_qnames:
+                    try:
+                        # Teardown dial: one short attempt (the capped
+                        # retry below handles the rest) — the default
+                        # 3x60s backoff dial would stack ~185s per queue
+                        # against a blackholed host, all outside the
+                        # shutdown timeout budget.
+                        self._client(executor_id, connect_timeout=5.0,
+                                     connect_attempts=1).send_eof(qname)
+                    except Exception:
+                        proc = id_to_proc.get(executor_id)
+                        if proc is not None and not proc.is_alive():
+                            # Normal teardown race: the node finished its
+                            # map_fun (e.g. inference loops exit on stop)
+                            # and closed its data plane before EOF landed.
+                            logger.debug("node %d exited before EOF on %r",
+                                         executor_id, qname)
+                            continue
+                        # The cached client's socket may have died with an
+                        # earlier timed-out call; this EOF is what unblocks
+                        # the node's next_batch, so retry once on a FRESH
+                        # connection before giving up.  One-shot socket
+                        # client: no shm-ring negotiation just to deliver
+                        # a ~20-byte EOF frame during teardown.
+                        stale = self._clients.pop(executor_id, None)
+                        if stale is not None:
+                            with contextlib.suppress(Exception):
+                                stale.close()
                         try:
-                            # Teardown dial: one short attempt (the capped
-                            # retry below handles the rest) — the default
-                            # 3x60s backoff dial would stack ~185s per queue
-                            # against a blackholed host, all outside the
+                            meta = self._fresh_meta(executor_id)
+                            # One short dial only: teardown against an
+                            # unreachable host must not stack the default
+                            # 3-attempt backoff (~3x60s) outside the
                             # shutdown timeout budget.
-                            self._client(executor_id, connect_timeout=5.0,
-                                         connect_attempts=1).send_eof(qname)
-                        except Exception:
-                            proc = id_to_proc.get(executor_id)
-                            if proc is not None and not proc.is_alive():
-                                # Normal teardown race: the node finished its
-                                # map_fun (e.g. inference loops exit on stop)
-                                # and closed its data plane before EOF landed.
-                                logger.debug("node %d exited before EOF on %r",
-                                             executor_id, qname)
-                                continue
-                            # The cached client's socket may have died with an
-                            # earlier timed-out call; this EOF is what unblocks
-                            # the node's next_batch, so retry once on a FRESH
-                            # connection before giving up.  One-shot socket
-                            # client: no shm-ring negotiation just to deliver
-                            # a ~20-byte EOF frame during teardown.
-                            stale = self._clients.pop(executor_id, None)
-                            if stale is not None:
-                                with contextlib.suppress(Exception):
-                                    stale.close()
+                            retry = DataClient(meta["host"], meta["data_port"],
+                                               self.authkey, prefer_ring=False,
+                                               call_timeout=30.0,
+                                               stall_timeout=30.0,
+                                               connect_timeout=5.0,
+                                               connect_attempts=1)
                             try:
-                                meta = self._fresh_meta(executor_id)
-                                # One short dial only: teardown against an
-                                # unreachable host must not stack the default
-                                # 3-attempt backoff (~3x60s) outside the
-                                # shutdown timeout budget.
-                                retry = DataClient(meta["host"], meta["data_port"],
-                                                   self.authkey, prefer_ring=False,
-                                                   call_timeout=30.0,
-                                                   stall_timeout=30.0,
-                                                   connect_timeout=5.0,
-                                                   connect_attempts=1)
-                                try:
-                                    retry.send_eof(qname)
-                                finally:
-                                    with contextlib.suppress(Exception):
-                                        retry.close()
-                            except Exception:
-                                logger.warning(
-                                    "could not send EOF to node %d queue %r",
-                                    executor_id, qname, exc_info=True)
+                                retry.send_eof(qname)
+                            finally:
+                                with contextlib.suppress(Exception):
+                                    retry.close()
+                        except Exception:
+                            logger.warning(
+                                "could not send EOF to node %d queue %r",
+                                executor_id, qname, exc_info=True)
             if grace_secs:
                 time.sleep(grace_secs)
             # Politely wait for map_funs to finish; only then escalate.  The
@@ -1320,6 +1403,8 @@ def run(
             tf_args=tf_args,
             queues=tuple(queues),
             input_qnames=tuple(q for q in queues if q not in ("output", "error")),
+            input_mode=("direct" if input_mode == InputMode.DIRECT
+                        else "streaming"),
             queue_capacity=queue_capacity,
             feed_timeout=feed_timeout,
             reservation_timeout=reservation_timeout,

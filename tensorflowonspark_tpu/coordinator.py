@@ -139,6 +139,11 @@ class CoordinatorServer:
         # counters restart with its process (per-incarnation counters).
         self._node_metrics: dict[int, dict] = {}
         self._hist_recent: dict[int, dict[str, list[float]]] = {}
+        # DIRECT-mode job manifest: what the driver's shard enumeration
+        # produced for the current train() (shard/partition/epoch counts),
+        # published so map_funs can read progress denominators without a
+        # side channel (ctx.job_manifest()).
+        self._manifest: dict = {}
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.address: tuple[str, int] | None = None
@@ -301,6 +306,13 @@ class CoordinatorServer:
             self._abort_rendezvous()
         return newly
 
+    def set_manifest(self, manifest: dict) -> None:
+        """Publish the DIRECT-mode shard manifest (driver-side; replaced
+        wholesale per train() call — JSON-serializable values only, the
+        control plane is JSON-framed)."""
+        with self._lock:
+            self._manifest = dict(manifest)
+
     def record_failure(self, executor_id: int, reason: str) -> None:
         """Driver-side synthesized node error (e.g. supervised restart budget
         exhausted) — surfaces through the same channel map_fun errors use."""
@@ -445,6 +457,9 @@ class CoordinatorServer:
                 return {"ok": True, "stop": self._stop_flag.is_set()}
             if op == "metrics":
                 return {"ok": True, "snapshot": self.cluster_metrics()}
+            if op == "manifest":
+                with self._lock:
+                    return {"ok": True, "manifest": dict(self._manifest)}
             if op == "deregister":
                 # node exiting deliberately (map_fun done, or error already
                 # reported): stop liveness tracking so the driver's dead-node
@@ -722,6 +737,11 @@ class CoordinatorClient:
     def metrics(self) -> dict:
         """Aggregated cluster metrics snapshot (the ``metrics`` op)."""
         return self._check(self._call({"op": "metrics"}))["snapshot"]
+
+    def manifest(self) -> dict:
+        """The driver-published DIRECT-mode job manifest (empty dict until
+        a DIRECT train() publishes one)."""
+        return self._check(self._call({"op": "manifest"}))["manifest"]
 
     def report_error(self, executor_id: int, traceback_str: str) -> None:
         self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})

@@ -87,6 +87,20 @@ class FeedQueues:
             return self._state.get(key)
 
 
+def batch_to_columns(batch: list, input_mapping: dict) -> dict:
+    """Reshape a row batch into the ``{name: [values...]}`` columnar dict
+    the reference's tensor-name ``input_mapping`` produced — shared by the
+    driver-streamed ``DataFeed`` and the DIRECT-mode ``ingest.IngestFeed``
+    so the two feed sources present identical batches to map_funs."""
+    names = list(input_mapping.values())
+    cols: dict[str, list] = {name: [] for name in names}
+    for item in batch:
+        values = item if isinstance(item, (list, tuple)) else (item,)
+        for name, v in zip(names, values):
+            cols[name].append(v)
+    return cols
+
+
 class IteratorFeed:
     """Adapt a plain Python iterator to the DataFeed consumption protocol
     (``next_batch``/``should_stop``), so direct-input-mode code (framework
@@ -211,13 +225,7 @@ class DataFeed:
         return batch
 
     def _to_columns(self, batch: list) -> dict:
-        names = list(self.input_mapping.values())
-        cols: dict[str, list] = {name: [] for name in names}
-        for item in batch:
-            values = item if isinstance(item, (list, tuple)) else (item,)
-            for name, v in zip(names, values):
-                cols[name].append(v)
-        return cols
+        return batch_to_columns(batch, self.input_mapping)
 
     # -- producing results (inference path) ----------------------------------
 

@@ -1,0 +1,38 @@
+"""tensorflowonspark_tpu.ingest — node-side direct ingestion (InputMode.DIRECT).
+
+The ``InputMode.TENSORFLOW`` half of the reference, rebuilt per the tf.data
+paper's input-pipeline design (PAPERS.md): the driver's partition ledger
+assigns TFRecord *shard paths* as work items — keeping at-least-once
+re-feed, elastic restart recovery, and incarnation fencing exactly as in
+streaming mode — and every node reads, CRC-verifies, decodes, and
+prefetches its shards itself, so aggregate feed bandwidth scales with the
+node count instead of capping at one driver core.
+
+Pieces:
+
+- :mod:`~tensorflowonspark_tpu.ingest.shards` — driver-side shard
+  enumeration (dir / glob / URI -> ledger partitions of paths);
+- :mod:`~tensorflowonspark_tpu.ingest.readers` — the
+  :class:`ReaderPipeline`: parallel-interleaved shard readers with bounded
+  decode queues, occupancy-autotuned parallelism, and prefetch helpers
+  (:func:`prefetch_iterator`, :func:`device_prefetch`);
+- :mod:`~tensorflowonspark_tpu.ingest.feed` — :class:`IngestFeed`, the
+  DIRECT-mode ``DataFeed`` twin a map_fun gets from ``ctx.get_data_feed()``.
+
+Knobs: ``TOS_INGEST_READERS`` (reader-pool ceiling), ``TOS_INGEST_PREFETCH``
+(decoded-chunk prefetch depth), ``TOS_INGEST_AUTOTUNE`` (occupancy-driven
+pool sizing).
+"""
+
+from tensorflowonspark_tpu.ingest.feed import IngestFeed  # noqa: F401
+from tensorflowonspark_tpu.ingest.readers import (  # noqa: F401
+    ReaderPipeline,
+    ShardDone,
+    ShardReadError,
+    device_prefetch,
+    prefetch_iterator,
+)
+from tensorflowonspark_tpu.ingest.shards import (  # noqa: F401
+    enumerate_shards,
+    shards_as_partitioned,
+)

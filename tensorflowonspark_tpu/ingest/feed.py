@@ -1,0 +1,299 @@
+"""``IngestFeed`` — the DIRECT-mode twin of ``feeding.DataFeed``.
+
+In ``InputMode.DIRECT`` the driver's partition ledger streams shard *paths*
+(tens of bytes each) instead of rows; this feed sits between the node's
+``FeedQueues`` and the user ``map_fun``, turning those paths into decoded
+record batches through the :class:`~tensorflowonspark_tpu.ingest.readers.
+ReaderPipeline` (parallel interleave + decode + prefetch):
+
+    input queue          claimer thread        reader pipeline     map_fun
+    paths + markers  ->  claims shards,    ->  N readers, CRC, ->  next_batch
+    (from the ledger)    tracks partitions     decode, prefetch
+
+Same consumption contract as ``DataFeed`` — and that contract is what makes
+the whole elastic machinery carry over to direct reads unchanged:
+
+- the node's **consumption watermark** (``FeedQueues.note_partition_consumed``)
+  advances only after every record of a ledger partition has been *returned
+  to the map_fun* — never merely read — so a death re-delivers any
+  partition whose records might not have been processed (duplicates
+  allowed, loss never);
+- keyed ``EndPartition`` markers dedupe an at-least-once re-feed of the
+  same partition (its shards are re-READ — duplicates at record level are
+  the at-least-once contract — but the watermark counts it once);
+- ``EndOfFeed`` / the node stop signal end the feed; ``terminate()``
+  fast-drains pending paths so driver feed calls unblock.
+
+The watermark bookkeeping rides the pipeline's ``ShardDone`` tokens: the
+chunk queue is FIFO, so popping a shard's token proves all its records left
+the queue; a partition reports consumed once every one of its shards' tokens
+has popped AND the batch carrying its last records has been handed back.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable
+
+from tensorflowonspark_tpu import faultinject, telemetry
+from tensorflowonspark_tpu.feeding import FeedQueues, batch_to_columns
+from tensorflowonspark_tpu.ingest.readers import ReaderPipeline, ShardDone
+from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
+
+
+class _PartitionJob:
+    """Watermark bookkeeping for one ledger partition of shard paths."""
+
+    __slots__ = ("key", "n_shards", "n_done", "closed")
+
+    def __init__(self):
+        self.key = None
+        self.n_shards = 0
+        self.n_done = 0
+        self.closed = False
+
+
+class IngestFeed:
+    """User-facing DIRECT-mode feed: ``next_batch``/``should_stop``/
+    ``batch_results``/``terminate``, drop-in for ``DataFeed`` inside a
+    map_fun.
+
+    Deltas from ``DataFeed`` (both deliberate): batches are record payloads
+    (``bytes``, or whatever ``decode`` returns), and SHARD seams inside a
+    ledger partition never truncate batches — shards interleave freely.  A
+    completed *ledger partition* does close the running batch (partial,
+    like DataFeed's EndPartition): the records must reach the map_fun
+    before the partition may be reported consumed, and holding them while
+    blocking for more data would freeze the watermark the driver's elastic
+    tail drain polls.
+    """
+
+    def __init__(
+        self,
+        queues: FeedQueues,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict[str, str] | None = None,
+        stop_event: threading.Event | None = None,
+        poll_interval: float = 0.25,
+        readers: int | None = None,
+        decode=None,
+        chunk_records: int = 256,
+        verify: bool = True,
+        prefetch: int | None = None,
+        autotune: bool | None = None,
+    ):
+        self.queues = queues
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.input_mapping = input_mapping
+        self.stop_event = stop_event
+        self.poll_interval = poll_interval
+        self.done_feeding = False
+        self._drained = False
+        self._leftover: list = []
+        self._claim_error: BaseException | None = None
+        self._terminated = threading.Event()
+        # the pipeline's own stop flag: terminate()/stop abandon in-flight
+        # reads without touching the node-wide stop_event
+        self._abandon = threading.Event()
+        self.pipeline = ReaderPipeline(
+            readers=readers, autotune=autotune, prefetch=prefetch,
+            chunk_records=chunk_records, decode=decode, verify=verify,
+            stop_event=self._abandon)
+        # partitions fully read AND fully handed to the map_fun, awaiting
+        # the safe moment to report (see _report_ready_keys)
+        self._jobs_lock = threading.Lock()
+        self._ready_keys: list = []
+        self._claimer = threading.Thread(target=self._claim_loop, daemon=True,
+                                         name="ingest-claimer")
+        self._claimer.start()
+
+    # -- claimer thread: input queue -> reader work items --------------------
+
+    def _claim_loop(self) -> None:
+        q = self.queues.get_queue(self.qname_in)
+        open_job: _PartitionJob | None = None
+        try:
+            while not self._terminated.is_set():
+                if self.stop_event is not None and self.stop_event.is_set():
+                    # node-wide stop: abandon in-flight reads too — the
+                    # readers must not keep churning through queued shards
+                    # for a consumer that is winding down
+                    self._abandon.set()
+                    return
+                try:
+                    item = q.get(timeout=self.poll_interval)
+                except queue.Empty:
+                    continue
+                if isinstance(item, EndPartition):
+                    job = open_job if open_job is not None else _PartitionJob()
+                    open_job = None
+                    with self._jobs_lock:
+                        job.key = getattr(item, "key", None)
+                        job.closed = True
+                        if job.n_done >= job.n_shards:
+                            # every shard already drained through the
+                            # consumer (or the partition was empty): ready —
+                            # the consumer reports it at its next safe point
+                            self._ready_keys.append(job.key)
+                    continue
+                if isinstance(item, EndOfFeed):
+                    return
+                if isinstance(item, Marker):
+                    continue
+                if not isinstance(item, str):
+                    raise TypeError(
+                        f"DIRECT-mode feed expects shard PATHS on queue "
+                        f"{self.qname_in!r}, got {type(item).__name__}: "
+                        "feed this cluster with cluster.train(<path_or_glob>) "
+                        "(InputMode.STREAMING is the mode that streams rows)")
+                if open_job is None:
+                    open_job = _PartitionJob()
+                with self._jobs_lock:
+                    open_job.n_shards += 1
+                self.pipeline.submit(item, open_job)
+        except BaseException as e:  # noqa: BLE001 - re-raised in next_batch
+            self._claim_error = e
+        finally:
+            self.pipeline.close()
+
+    # -- consumer side (the map_fun) -----------------------------------------
+
+    def _has_ready_keys(self) -> bool:
+        with self._jobs_lock:
+            return bool(self._ready_keys)
+
+    def _report_ready_keys(self) -> None:
+        """Report partitions whose records have all been handed back.  Only
+        called when the consumer holds NO undelivered records (top of
+        next_batch, or mid-poll with an empty batch in hand) — the watermark
+        must lag the map_fun, never lead it."""
+        with self._jobs_lock:
+            if not self._ready_keys:
+                return
+            keys, self._ready_keys = self._ready_keys, []
+        for key in keys:
+            self.queues.note_partition_consumed(self.qname_in, key)
+
+    def _on_shard_done(self, token: ShardDone, batch_empty: bool) -> None:
+        job = token.tag
+        if job is None:
+            return
+        report = False
+        with self._jobs_lock:
+            job.n_done += 1
+            if job.closed and job.n_done >= job.n_shards:
+                if batch_empty:
+                    # FIFO: every record of this partition was popped before
+                    # its last ShardDone, and with nothing in hand they were
+                    # all in batches ALREADY returned — safe to report now
+                    # (must not wait for a next_batch call that may never
+                    # come: the elastic tail drain polls this watermark)
+                    report = True
+                else:
+                    self._ready_keys.append(job.key)
+                key = job.key
+        if report:
+            self.queues.note_partition_consumed(self.qname_in, key)
+
+    def next_batch(self, batch_size: int) -> list | dict:
+        """Pop up to ``batch_size`` decoded records; the batch goes partial
+        at end-of-feed / stop / a completed ledger partition (shard seams
+        inside a partition never truncate it)."""
+        self._report_ready_keys()  # the previous batch has been handed over
+        batch: list = []
+        while len(batch) < batch_size:
+            if self._leftover:
+                take = batch_size - len(batch)
+                batch.extend(self._leftover[:take])
+                del self._leftover[:take]
+                continue
+            if self._claim_error is not None:
+                # checked BEFORE the drained branch: a dying claimer closes
+                # the pipeline, so the drain sentinel races this error into
+                # the same poll window — ending the feed "cleanly" here
+                # would swallow the failure and strand the driver's feed
+                raise RuntimeError(
+                    f"ingest claim loop failed: {self._claim_error}"
+                ) from self._claim_error
+            if self._drained:
+                if batch:
+                    # hand the final records back WITHOUT flagging done: the
+                    # map_fun's next call (the proof this batch was
+                    # processed) flushes the last partition's consumption
+                    # report, then sees done — mirroring DataFeed, where
+                    # EndOfFeed always pops on a later call than the batch
+                    # that closed the final partition
+                    break
+                self.done_feeding = True
+                break
+            if not batch:
+                # nothing undelivered in hand: partitions the claimer closed
+                # while we were blocked here are safe to report immediately
+                self._report_ready_keys()
+            elif self._has_ready_keys():
+                # a LEDGER partition finished behind the records in hand:
+                # close the batch now (DataFeed's partition-end partial
+                # batch, at ledger granularity) — blocking here to top the
+                # batch up could hold these records indefinitely between
+                # feeds, freezing the consumption watermark the driver's
+                # elastic tail drain waits on
+                break
+            if self.stop_event is not None and self.stop_event.is_set():
+                self.pipeline.stop()
+                self.done_feeding = True
+                break
+            try:
+                item = self.pipeline.get(timeout=self.poll_interval)
+            except queue.Empty:
+                continue
+            if item is None:  # pipeline fully drained (EndOfFeed reached)
+                self._drained = True
+                continue
+            if isinstance(item, ShardDone):
+                self._on_shard_done(item, batch_empty=not batch)
+                continue
+            self._leftover = item  # one decoded chunk (a list)
+        if batch:
+            telemetry.counter("feed.batches").inc()
+            telemetry.counter("feed.rows_consumed").inc(len(batch))
+            # same chaos clock as DataFeed: `kill:after_batches=N` fires on
+            # consumed batches, so kill-mid-shard tests run in DIRECT mode
+            faultinject.batch_consumed()
+        if self.input_mapping:
+            return batch_to_columns(batch, self.input_mapping)
+        return batch
+
+    # -- producing results ---------------------------------------------------
+
+    def batch_results(self, results: Iterable[Any], chunk: bool = False) -> None:
+        """Emit results to the output queue (parity with ``DataFeed``)."""
+        q = self.queues.get_queue(self.qname_out)
+        if chunk:
+            q.put(ResultChunk(results))
+            return
+        for r in results:
+            q.put(r)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def should_stop(self) -> bool:
+        return self.done_feeding
+
+    def terminate(self) -> None:
+        """Stop consuming: abandon in-flight reads, mark terminating, and
+        fast-drain pending paths so upstream feed calls unblock."""
+        self.done_feeding = True
+        self._terminated.set()
+        self._abandon.set()
+        self.queues.set("state", "terminating")
+        q = self.queues.get_queue(self.qname_in)
+        while True:
+            try:
+                q.get(block=True, timeout=0.05)
+            except queue.Empty:
+                return

@@ -1,0 +1,410 @@
+"""Node-side shard reader pipeline: parallel interleave + decode + prefetch.
+
+The DIRECT-input-mode data plane (the reference's ``InputMode.TENSORFLOW``,
+per the tf.data paper's parallel-interleave/prefetch design, PAPERS.md):
+instead of the driver pumping every row over one socket, each node claims
+TFRecord *shard paths* and reads the bytes itself —
+
+    work queue (paths) -> N reader threads -> bounded chunk queue -> consumer
+                          read + CRC-verify     (the prefetch buffer)
+                          + decode
+
+- **Readers** pull whole shards off the shared work queue (tf.data's
+  ``interleave(cycle_length=N)``): plain shards via one
+  ``tfrecord.read_record_spans`` IO read + native CRC scan, gzip shards via
+  streaming decompression (never a whole-file inflate).  An optional
+  ``decode`` callable runs per record inside the reader thread, so decode
+  parallelism rides reader parallelism.
+- **The chunk queue is the prefetch buffer** (``TOS_INGEST_PREFETCH``
+  chunks deep): readers run ahead of the consumer by up to that many
+  decoded chunks, and block (backpressure) beyond it.
+- **Autotuned parallelism** (``TOS_INGEST_AUTOTUNE``, tf.data-paper style):
+  rather than a fixed thread knob, the consumer's pops sample the queue's
+  occupancy — a starving consumer (queue near empty, work pending) grows
+  the reader pool toward ``TOS_INGEST_READERS``; a saturated queue shrinks
+  it (readers retire at shard boundaries).  Occupancy, pool size, and every
+  spawn/retire are exported through ``telemetry``.
+
+``IngestFeed`` (``ingest/feed.py``) drives this pipeline from the node's
+feed queue; ``bench_ingest.py`` drives it raw for the scaling numbers.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.utils.envtune import env_bool as _env_bool
+from tensorflowonspark_tpu.utils.envtune import env_int as _env_int
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+logger = logging.getLogger(__name__)
+
+# Autotune thresholds on the occupancy EMA (fraction of queue capacity):
+# below LOW with work pending the consumer is starving (grow the pool);
+# above HIGH the readers outrun the consumer (shrink it — the threads
+# would only block on the full queue anyway).
+_TUNE_LOW = 0.25
+_TUNE_HIGH = 0.85
+_TUNE_INTERVAL_SECS = 0.2
+_EMA_ALPHA = 0.3
+
+
+class ShardReadError(RuntimeError):
+    """A reader thread failed on a shard (corrupt CRC, IO error, decode
+    bug); re-raised at the consumer with the shard path attached."""
+
+
+class ShardDone:
+    """Control token: every record of one claimed shard has been pushed
+    (FIFO) before this token — popping it proves the shard fully drained
+    out of the chunk queue.  ``tag`` is the submitter's opaque bookkeeping
+    handle (the ingest feed's partition job)."""
+
+    __slots__ = ("path", "tag")
+
+    def __init__(self, path: str, tag=None):
+        self.path = path
+        self.tag = tag
+
+
+class _Failure:
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+_DRAINED = object()
+
+
+class ReaderPipeline:
+    """Parallel shard readers feeding one bounded decoded-chunk queue.
+
+    Thread roles: ``submit``/``close`` are producer-side (one thread — the
+    ingest feed's claimer, or a bench loop); ``get`` is consumer-side (one
+    thread — the map_fun via ``IngestFeed``); reader threads are internal.
+    """
+
+    def __init__(self, *, readers: int | None = None,
+                 autotune: bool | None = None, prefetch: int | None = None,
+                 chunk_records: int = 256, decode=None, verify: bool = True,
+                 stop_event: threading.Event | None = None):
+        self._max_readers = max(0, readers if readers is not None
+                                else _env_int("TOS_INGEST_READERS", 4, minimum=0))
+        # readers=0: SYNCHRONOUS mode — no reader threads at all, get()
+        # reads the next shard inline in the consumer thread (the tf.data
+        # ``num_parallel_calls=None`` analogue).  Trades away read/compute
+        # overlap for zero cross-thread traffic — the right shape when a
+        # node has one core to its name (bench_ingest measures node
+        # scale-out in exactly this configuration).
+        self._sync = self._max_readers == 0
+        self._autotune = (not self._sync) and (
+            autotune if autotune is not None
+            else _env_bool("TOS_INGEST_AUTOTUNE", True))
+        depth = max(1, prefetch if prefetch is not None
+                    else _env_int("TOS_INGEST_PREFETCH", 8))
+        self.chunk_records = max(1, chunk_records)
+        self.decode = decode
+        self.verify = verify
+        # sync mode buffers one whole shard's chunks at a time (get() is
+        # both reader and consumer, so a bounded put would self-deadlock)
+        self._out: queue.Queue = queue.Queue(maxsize=0 if self._sync else depth)
+        self._work: queue.Queue = queue.Queue()  # paths: tiny, unbounded
+        self._stop = stop_event if stop_event is not None else threading.Event()
+        self._lock = threading.Lock()
+        self._active = 0
+        self._target = 1 if self._autotune else self._max_readers
+        self._closed = False
+        self._drained_pushed = False
+        # consumer-side autotune state (touched only from get(); the lock
+        # covers the reader-pool fields both sides mutate)
+        self._occupancy_ema = 0.0
+        self._last_tune = time.monotonic()
+        for _ in range(self._target):
+            self._spawn_reader_locked()  # pre-publication: no lock needed yet
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, path: str, tag=None) -> None:
+        """Queue one shard path for a reader to claim; ``tag`` rides the
+        shard's ``ShardDone`` token back to the consumer."""
+        self._work.put((path, tag))
+
+    def close(self) -> None:
+        """No more shards will be submitted; readers exit as the work queue
+        drains, and the consumer sees end-of-pipeline after the last chunk."""
+        with self._lock:
+            self._closed = True
+            # sync mode signals drain via (closed AND work empty) inside
+            # _sync_get — pushing the sentinel here would let it overtake
+            # still-queued work items
+            push = (not self._sync and self._active == 0
+                    and not self._drained_pushed)
+            if push:
+                self._drained_pushed = True
+        if push:
+            # outside the lock: the put may block on a full prefetch queue,
+            # and the consumer needs the lock to drain it (autotune path)
+            self._put(_DRAINED)
+
+    def stop(self) -> None:
+        """Abandon everything in flight (terminate/stop-signal path)."""
+        self._stop.set()
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self, timeout: float = 0.25):
+        """Pop the next item: a list of records (one decoded chunk), a
+        :class:`ShardDone` token, or ``None`` once the pipeline has fully
+        drained.  Raises ``queue.Empty`` on timeout and
+        :class:`ShardReadError` when a reader failed."""
+        if self._sync:
+            return self._sync_get(timeout)
+        self._maybe_tune()
+        item = self._out.get(timeout=timeout)
+        if item is _DRAINED:
+            return None
+        if isinstance(item, _Failure):
+            raise item.error
+        return item
+
+    def _sync_get(self, timeout: float):
+        """readers=0: serve buffered chunks, else read the next shard
+        INLINE in the calling (consumer) thread."""
+        try:
+            item = self._out.get_nowait()
+        except queue.Empty:  # toslint: allow-silent(no buffered chunk yet: fall through to claim the next shard)
+            pass
+        else:
+            if item is _DRAINED:
+                return None
+            return item
+        if self._stop.is_set():
+            return None
+        try:
+            path, tag = self._work.get(timeout=timeout)
+        except queue.Empty:
+            with self._lock:
+                if self._closed:
+                    return None
+            raise
+        try:
+            with telemetry.timed("ingest.shard_read_secs"):
+                self._read_one(path, tag)
+        except Exception as e:  # noqa: BLE001 - same contract as the pool
+            wrapped = ShardReadError(f"reading shard {path!r} failed: {e}")
+            wrapped.__cause__ = e
+            telemetry.counter("ingest.reader_errors").inc()
+            raise wrapped from e
+        return self._sync_get(timeout)
+
+    def _maybe_tune(self) -> None:
+        """Occupancy-EMA autotune, driven by consumer pops (no timer
+        thread): grow while the consumer starves, shrink while readers
+        saturate the queue.  Sampling at pop time biases toward the moments
+        that matter — when the consumer actually wants data."""
+        occupancy = self._out.qsize()
+        telemetry.gauge("ingest.prefetch_depth").set(occupancy)
+        if not self._autotune:
+            return
+        self._occupancy_ema += _EMA_ALPHA * (occupancy / self._out.maxsize
+                                             - self._occupancy_ema)
+        now = time.monotonic()
+        if now - self._last_tune < _TUNE_INTERVAL_SECS:
+            return
+        self._last_tune = now
+        telemetry.gauge("ingest.queue_occupancy").set(
+            round(self._occupancy_ema, 4))
+        if (self._occupancy_ema < _TUNE_LOW and not self._work.empty()):
+            # closed does NOT gate growth: it only means no more submits,
+            # and the work queue may still be deep
+            with self._lock:
+                if self._target < self._max_readers and self._active > 0:
+                    self._target += 1
+                    self._spawn_reader_locked()
+                    telemetry.counter("ingest.reader_spawns").inc()
+        elif self._occupancy_ema > _TUNE_HIGH:
+            with self._lock:
+                if self._target > 1:
+                    self._target -= 1  # a reader retires at its next boundary
+
+    # -- reader pool ---------------------------------------------------------
+
+    def _spawn_reader_locked(self) -> None:
+        """Start one reader; caller holds ``self._lock`` (or is __init__,
+        pre-publication)."""
+        self._active += 1
+        telemetry.gauge("ingest.readers_active").set(self._active)
+        threading.Thread(target=self._reader_loop, daemon=True,
+                         name=f"ingest-reader-{self._active}").start()
+
+    def _reader_loop(self) -> None:
+        retired = False
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    if self._active > self._target:
+                        # autotune shrink: exactly one reader retires per
+                        # decrement, accounted here so the exit path below
+                        # never double-counts (target >= 1, so a retiree is
+                        # never the last reader)
+                        self._active -= 1
+                        retired = True
+                        telemetry.counter("ingest.reader_retires").inc()
+                        telemetry.gauge("ingest.readers_active").set(self._active)
+                        return
+                try:
+                    path, tag = self._work.get(timeout=0.1)
+                except queue.Empty:
+                    with self._lock:
+                        if self._closed:
+                            return
+                    continue
+                try:
+                    with telemetry.timed("ingest.shard_read_secs"):
+                        self._read_one(path, tag)
+                except Exception as e:  # noqa: BLE001 - re-raised consumer-side
+                    wrapped = ShardReadError(f"reading shard {path!r} failed: {e}")
+                    wrapped.__cause__ = e
+                    telemetry.counter("ingest.reader_errors").inc()
+                    self._put(_Failure(wrapped))
+                    return
+        finally:
+            if not retired:
+                push = False
+                with self._lock:
+                    self._active -= 1
+                    telemetry.gauge("ingest.readers_active").set(self._active)
+                    if (self._active == 0
+                            and (self._closed or self._stop.is_set())
+                            and not self._drained_pushed):
+                        self._drained_pushed = True
+                        push = True
+                if push:
+                    # outside the lock (the put can block on a full queue
+                    # whose consumer needs the lock); _put gives up only
+                    # when stop is set AND the consumer stopped draining,
+                    # at which point nobody would read the sentinel anyway
+                    self._put(_DRAINED)
+
+    def _read_one(self, path: str, tag) -> None:
+        """Read + verify one whole shard, pushing decoded chunks then the
+        shard's ``ShardDone``.  Plain shards take the span path — ONE open,
+        one native CRC scan, per-record slices (on remote filesystems every
+        extra open is a metadata round-trip); gzip shards stream (probe
+        open + gzip.open)."""
+        local = resolve_uri(path)
+        decode = self.decode
+        nbytes = 0
+        nrecs = 0
+        chunk: list = []
+        with open(local, "rb") as f:
+            gz = tfrecord._is_gzip_shard(f.read(12))
+            if gz:
+                buf = None
+            else:
+                f.seek(0)
+                buf = f.read()  # one read, no probe+rest concat copy
+        if gz:
+            payloads = tfrecord.read_records(local, verify=self.verify,
+                                             gzipped=True)
+        else:
+            spans = tfrecord.scan_record_spans(buf, self.verify, name=local)
+            payloads = (buf[off:off + length] for off, length in spans)
+        for payload in payloads:
+            nbytes += len(payload)
+            nrecs += 1
+            chunk.append(decode(payload) if decode is not None else payload)
+            if len(chunk) >= self.chunk_records:
+                if not self._put(chunk):
+                    return  # stopped with the consumer gone
+                chunk = []
+        if chunk and not self._put(chunk):
+            return
+        self._put(ShardDone(path, tag))
+        telemetry.counter("ingest.shards_read").inc()
+        telemetry.counter("ingest.records_read").inc(nrecs)
+        telemetry.counter("ingest.bytes_read").inc(nbytes)
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to stop(): blocking on the full
+        prefetch queue IS the backpressure, but an abandoned pipeline (stop
+        set, consumer gone) must not strand the reader thread forever."""
+        while True:
+            try:
+                self._out.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                if self._stop.is_set():
+                    return False
+
+
+def prefetch_iterator(iterable, depth: int = 2):
+    """Host-side prefetch: a background thread runs the source iterator up
+    to ``depth`` items ahead of the consumer (the tf.data ``prefetch``
+    stage).  Source exceptions re-raise at the consumer, at the position
+    they would have surfaced unprefetched."""
+    if depth <= 0:
+        yield from iterable
+        return
+    buf: queue.Queue = queue.Queue(maxsize=depth)
+    DONE = object()
+    stopped = threading.Event()
+    failure: list[BaseException] = []
+
+    def _bounded_put(item) -> bool:
+        while not stopped.is_set():
+            try:
+                buf.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce() -> None:
+        try:
+            for item in iterable:
+                if not _bounded_put(item):
+                    return  # consumer abandoned the generator
+        except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+            failure.append(e)
+        finally:
+            _bounded_put(DONE)
+
+    thread = threading.Thread(target=_produce, name="ingest-prefetch",
+                              daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = buf.get()
+            if item is DONE:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stopped.set()  # an abandoning consumer must not strand the producer
+
+
+def device_prefetch(batches, depth: int = 2, device=None):
+    """Prefetch-to-device double buffering: ``jax.device_put`` batch N+1
+    while the consumer computes on batch N (the host->device half of the
+    tf.data-paper pipeline; ``parallel.dp.make_batch_iterator`` applies the
+    same idea to streaming feeds).  Degrades to host-side prefetch when jax
+    is unavailable (pure-IO consumers, tests without a backend)."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 - jax-free consumers still prefetch
+        yield from prefetch_iterator(batches, depth)
+        return
+
+    def _placed():
+        for batch in batches:
+            yield jax.device_put(batch, device)
+
+    yield from prefetch_iterator(_placed(), depth)

@@ -1,0 +1,92 @@
+"""Driver-side shard enumeration for ``InputMode.DIRECT``.
+
+``cluster.train(path_or_glob)`` in DIRECT mode must turn one user string
+into the ledger's work items — a ``PartitionedDataset`` whose partitions
+carry shard *paths* (one shard per partition by default, the finest
+reassignment granularity a node death can trigger).  The enumeration runs
+on the driver but the *fed* paths keep the user's URI scheme: a node
+resolves each path against its OWN mounts (``utils.paths.resolve_uri``),
+so a cluster whose hosts mount ``hopsfs://`` at different roots still
+reads the right files — the reference got the same property from the
+Hadoop FS client resolving paths executor-side.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+from tensorflowonspark_tpu.data import PartitionedDataset
+from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+_GLOB_CHARS = frozenset("*?[")
+
+
+def enumerate_shards(spec) -> list[str]:
+    """Expand a DIRECT-mode input spec into a sorted list of shard paths.
+
+    Accepts:
+
+    - a **directory** (local path or registered URI): its ``part-*`` shard
+      files (the ``dfutil.save_as_tfrecords`` layout);
+    - a **glob** (contains ``*``/``?``/``[``): every match;
+    - a **single file**;
+    - a **list/tuple of paths**: used verbatim (already enumerated).
+
+    URIs resolve through ``utils.paths`` for the *enumeration* only; the
+    returned paths keep the original scheme so each node re-resolves them
+    against its own mounts.
+    """
+    if isinstance(spec, (list, tuple)):
+        paths = [os.fspath(p) for p in spec]
+        if not paths:
+            raise FileNotFoundError("empty shard list for DIRECT-mode train")
+        return paths
+    spec = os.fspath(spec)
+    local = resolve_uri(spec)
+    prefix_len = len(local)  # to graft matches back under the original URI
+
+    def _restore(match: str) -> str:
+        # '/mnt/hopsfs/data/part-0' back to 'hopsfs://nn/data/part-0'
+        if match.startswith(local) and local != spec:
+            return spec + match[prefix_len:]
+        return match
+
+    if any(c in local for c in _GLOB_CHARS):
+        matches = sorted(_glob.glob(local))
+        if not matches:
+            raise FileNotFoundError(f"no shard files match {spec!r}")
+        return [_restore(m) for m in matches]
+    if os.path.isdir(local):
+        matches = sorted(
+            f for f in _glob.glob(os.path.join(local, "part-*"))
+            if not f.endswith(".json"))
+        if not matches:
+            raise FileNotFoundError(f"no 'part-*' shard files under {spec!r}")
+        sep = "" if spec.endswith("/") else "/"
+        return [spec + sep + os.path.basename(m) if local != spec else m
+                for m in matches]
+    if os.path.exists(local):
+        return [spec]
+    raise FileNotFoundError(f"DIRECT-mode input {spec!r} does not exist "
+                            "(expected a shard directory, glob, or file)")
+
+
+def shards_as_partitioned(spec, num_partitions: int | None = None
+                          ) -> PartitionedDataset:
+    """Ledger work items for a DIRECT-mode train: partitions of shard paths.
+
+    Default is ONE shard per partition — each ledger task is a single file,
+    so a node death mid-epoch re-assigns exactly the unread shards, and
+    ``shuffle_seed`` reorders individual shards between epochs.  Pass
+    ``num_partitions`` to group shards (round-robin, sizes even out) when a
+    dataset has so many tiny files that per-shard ledger acks would dominate.
+    """
+    if isinstance(spec, PartitionedDataset):
+        return spec
+    files = enumerate_shards(spec)
+    n = len(files) if num_partitions is None else num_partitions
+    if not 0 < n <= len(files):
+        raise ValueError(f"num_partitions={n} must be in 1..{len(files)} "
+                         "(number of shard files)")
+    return PartitionedDataset.from_partitions([files[i::n] for i in range(n)])

@@ -54,6 +54,9 @@ class NodeConfig:
     tf_args: Any = None
     queues: Sequence[str] = ("input", "output", "error")
     input_qnames: Sequence[str] = ("input",)
+    # "streaming" (driver streams rows) or "direct" (the feed carries shard
+    # PATHS and ctx.get_data_feed returns the node-side ingest pipeline).
+    input_mode: str = "streaming"
     queue_capacity: int = 1024
     feed_timeout: float = 600.0
     reservation_timeout: float = 120.0
@@ -129,10 +132,65 @@ class NodeContext:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping: dict | None = None,
-    ) -> DataFeed:
-        """Reference: ``TFNode.DataFeed(ctx.mgr, ...)`` (``TFNode.py:~250``)."""
+        **ingest_opts,
+    ):
+        """Reference: ``TFNode.DataFeed(ctx.mgr, ...)`` (``TFNode.py:~250``).
+
+        The feed-source switch: on a STREAMING cluster this is the
+        driver-streamed ``DataFeed``; on a DIRECT cluster the same call
+        returns an :class:`~tensorflowonspark_tpu.ingest.IngestFeed` — the
+        node-side reader pipeline over the shard paths the ledger assigns —
+        so one map_fun body serves both input modes.  ``ingest_opts``
+        (``decode=``, ``readers=``, ``verify=``, ...) configure the
+        pipeline and are DIRECT-only; see :meth:`get_ingest_feed`.
+        """
+        if self._config.input_mode == "direct":
+            return self.get_ingest_feed(
+                train_mode=train_mode, qname_in=qname_in, qname_out=qname_out,
+                input_mapping=input_mapping, **ingest_opts)
+        if ingest_opts:
+            raise TypeError(
+                f"ingest options {sorted(ingest_opts)} need InputMode.DIRECT "
+                "(alias TENSORFLOW); this cluster runs InputMode.STREAMING "
+                "(alias SPARK), whose feed carries driver-streamed rows")
         return DataFeed(self.queues, train_mode, qname_in, qname_out, input_mapping,
                         stop_event=self.stop_requested)
+
+    def get_ingest_feed(
+        self,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict | None = None,
+        readers: int | None = None,
+        decode=None,
+        chunk_records: int = 256,
+        verify: bool = True,
+        prefetch: int | None = None,
+        autotune: bool | None = None,
+    ):
+        """DIRECT-mode feed: shard paths in, decoded record batches out.
+
+        ``decode`` runs per record inside the reader threads (e.g.
+        ``lambda rec: dfutil.from_example(rec, schema)``); ``None`` yields
+        raw payload ``bytes``.  ``readers``/``prefetch``/``autotune``
+        override the ``TOS_INGEST_*`` knobs; ``verify=False`` skips CRC
+        checks for trusted local data.
+        """
+        from tensorflowonspark_tpu.ingest import IngestFeed
+
+        return IngestFeed(
+            self.queues, train_mode, qname_in, qname_out, input_mapping,
+            stop_event=self.stop_requested, readers=readers, decode=decode,
+            chunk_records=chunk_records, verify=verify, prefetch=prefetch,
+            autotune=autotune)
+
+    def job_manifest(self) -> dict:
+        """The driver-published description of the current DIRECT-mode feed
+        (shard/partition/epoch counts — what ``cluster.train(path)``
+        enumerated), for map_funs that want progress denominators.  Empty
+        until a DIRECT train publishes one."""
+        return self._client.manifest()
 
     # -- path plumbing -------------------------------------------------------
 

@@ -121,6 +121,7 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
     """
     counters = aggregated.get("counters") or {}
     rx_bytes = counters.get("dataplane.rx_bytes")
+    ingest_bytes = counters.get("ingest.bytes_read")
     report: dict[str, Any] = {
         "schema": "tos-run-report-v1",
         "written_at": time.time(),
@@ -128,6 +129,13 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
         "throughput_mb_per_s": (
             round(rx_bytes / wall_secs / 1e6, 3)
             if rx_bytes and wall_secs else None),
+        # DIRECT-mode twin of the driver-pump number: bytes the nodes read
+        # straight from storage (cluster aggregate), which never transit
+        # the data plane and so never land in dataplane.rx_bytes
+        "ingest_mb_per_s": (
+            round(ingest_bytes / wall_secs / 1e6, 3)
+            if ingest_bytes and wall_secs else None),
+        "records_ingested": counters.get("ingest.records_read"),
         "rows_fed": counters.get("dataplane.rows_in"),
         "rows_consumed": counters.get("feed.rows_consumed"),
         "restarts_total": counters.get("elastic.restarts_total", 0),

@@ -85,12 +85,48 @@ class RecordError(ValueError):
     pass
 
 
+def scan_record_spans(buf: bytes, verify: bool = True,
+                      name: str = "<buffer>") -> list[tuple[int, int]]:
+    """(offset, length) payload spans of an in-memory PLAIN shard buffer
+    (native whole-buffer scan when built, Python fallback otherwise).
+    ``name`` labels errors.  The buffer-level half of ``read_record_spans``,
+    exposed so callers that already hold the bytes (the ingest readers, one
+    open per shard) never re-open the file."""
+    if _native is not None:
+        try:
+            spans, consumed = _native.scan_records(buf, verify)
+        except ValueError as e:
+            raise RecordError(f"{name}: {e}") from None
+        if consumed != len(buf):
+            raise RecordError(f"{name}: truncated record at offset {consumed}")
+        return [(int(o), int(n)) for o, n in spans]
+    spans = []
+    pos = 0
+    while pos < len(buf):
+        if pos + 12 > len(buf):
+            raise RecordError(f"{name}: truncated header at offset {pos}")
+        (length,) = _U64.unpack_from(buf, pos)
+        if verify and masked_crc32c(buf[pos:pos + 8]) != _U32.unpack_from(buf, pos + 8)[0]:
+            raise RecordError(f"{name}: corrupt length crc at offset {pos}")
+        start = pos + 12
+        if start + length + 4 > len(buf):
+            raise RecordError(f"{name}: truncated record at offset {pos}")
+        if verify and masked_crc32c(buf[start:start + length]) != \
+                _U32.unpack_from(buf, start + length)[0]:
+            raise RecordError(f"{name}: corrupt data crc at offset {pos}")
+        spans.append((start, length))
+        pos = start + length + 4
+    return spans
+
+
 def read_record_spans(path: str, verify: bool = True) -> tuple[bytes, list[tuple[int, int]]]:
     """Whole-shard buffer + (offset, length) payload spans.
 
     The zero-copy companion of ``read_records`` for columnar consumers
     (``dfutil.read_shard_columns`` / the native Example parser): one buffer,
-    one scan, no per-record slicing.  Handles gzip like ``read_records``.
+    one scan, no per-record slicing.  Handles gzip, but INFLATES the whole
+    shard into memory to do it (the one-buffer contract requires it) — for
+    gzip shards of unbounded size prefer ``read_records``, which streams.
     """
     import gzip
 
@@ -98,31 +134,7 @@ def read_record_spans(path: str, verify: bool = True) -> tuple[bytes, list[tuple
         buf = f.read()
     if _is_gzip_shard(buf[:12]):
         buf = gzip.decompress(buf)
-    if _native is not None:
-        try:
-            spans, consumed = _native.scan_records(buf, verify)
-        except ValueError as e:
-            raise RecordError(f"{path}: {e}") from None
-        if consumed != len(buf):
-            raise RecordError(f"{path}: truncated record at offset {consumed}")
-        return buf, [(int(o), int(n)) for o, n in spans]
-    spans = []
-    pos = 0
-    while pos < len(buf):
-        if pos + 12 > len(buf):
-            raise RecordError(f"{path}: truncated header at offset {pos}")
-        (length,) = _U64.unpack_from(buf, pos)
-        if verify and masked_crc32c(buf[pos:pos + 8]) != _U32.unpack_from(buf, pos + 8)[0]:
-            raise RecordError(f"{path}: corrupt length crc at offset {pos}")
-        start = pos + 12
-        if start + length + 4 > len(buf):
-            raise RecordError(f"{path}: truncated record at offset {pos}")
-        if verify and masked_crc32c(buf[start:start + length]) != \
-                _U32.unpack_from(buf, start + length)[0]:
-            raise RecordError(f"{path}: corrupt data crc at offset {pos}")
-        spans.append((start, length))
-        pos = start + length + 4
-    return buf, spans
+    return buf, scan_record_spans(buf, verify, name=path)
 
 
 def _is_gzip_shard(head: bytes) -> bool:
@@ -140,46 +152,77 @@ def _is_gzip_shard(head: bytes) -> bool:
                 and masked_crc32c(head[:8]) == _U32.unpack_from(head, 8)[0])
 
 
-def read_records(path: str, verify: bool = True) -> Iterator[bytes]:
+def is_gzipped_shard(path: str) -> bool:
+    """Whether the shard file is whole-stream gzipped (by header probe).
+
+    The ingest reader pipeline keys its read strategy on this: plain shards
+    go through ``read_record_spans`` (one IO read, one native CRC scan, span
+    slices); gzip shards stream-decompress so a multi-GB shard never
+    inflates into one buffer inside a reader thread.
+    """
+    with open(path, "rb") as probe:
+        return _is_gzip_shard(probe.read(12))
+
+
+def _stream_records(f, path: str, verify: bool) -> Iterator[bytes]:
+    """Streaming framing parser over an open (possibly gzip) file object:
+    constant memory regardless of shard size.  crc32c is the native slice-
+    by-8 implementation when built (module-level swap), so streaming does
+    not give up the fast checksum — only the whole-buffer C++ scan."""
+    offset = 0
+    while True:
+        hdr = f.read(12)
+        if not hdr:
+            return
+        if len(hdr) < 12:
+            raise RecordError(f"{path}: truncated header at offset {offset}")
+        (length,) = _U64.unpack_from(hdr, 0)
+        (length_crc,) = _U32.unpack_from(hdr, 8)
+        if verify and masked_crc32c(hdr[:8]) != length_crc:
+            raise RecordError(f"{path}: corrupt length crc at offset {offset}")
+        data = f.read(length)
+        footer = f.read(4)
+        if len(data) < length or len(footer) < 4:
+            raise RecordError(f"{path}: truncated record at offset {offset}")
+        if verify and masked_crc32c(data) != _U32.unpack(footer)[0]:
+            raise RecordError(f"{path}: corrupt data crc at offset {offset}")
+        yield data
+        offset += 12 + length + 4
+
+
+def read_records(path: str, verify: bool = True,
+                 gzipped: bool | None = None) -> Iterator[bytes]:
     """Yield raw record payloads from a TFRecord file.
 
-    With the native codec, the whole shard is scanned in C++ (one CRC pass,
-    no per-record Python framing work); otherwise a streaming Python parser.
+    Plain shards with the native codec are scanned whole in C++ (one CRC
+    pass, no per-record Python framing work); otherwise a streaming Python
+    parser.
 
     GZIP-compressed shards (TF's ``TFRecordOptions('GZIP')`` format — the
     whole stream gzipped; the reference's Hadoop TFRecord input supported
     the same) are detected by magic bytes and decompressed transparently
-    (see ``_is_gzip_shard``).
+    (see ``_is_gzip_shard``) — and ALWAYS via streaming decompression
+    (``gzip.open``), never a whole-file ``gzip.decompress``: a multi-GB
+    gzip shard must not inflate into one buffer before the first record
+    can be yielded (it would OOM an ingest reader thread).
+
+    ``gzipped`` skips the header probe when the caller already knows (the
+    ingest readers probe once per shard — on remote filesystems every
+    extra open is a metadata round-trip).
     """
     import gzip
 
+    if gzipped if gzipped is not None else is_gzipped_shard(path):
+        with gzip.open(path, "rb") as f:
+            yield from _stream_records(f, path, verify)
+        return
     if _native is not None:
         buf, spans = read_record_spans(path, verify)
         for off, length in spans:
             yield buf[off : off + length]
         return
-    with open(path, "rb") as probe:
-        is_gzip = _is_gzip_shard(probe.read(12))
-    with (gzip.open(path, "rb") if is_gzip else open(path, "rb")) as f:
-        offset = 0
-        while True:
-            hdr = f.read(12)
-            if not hdr:
-                return
-            if len(hdr) < 12:
-                raise RecordError(f"{path}: truncated header at offset {offset}")
-            (length,) = _U64.unpack_from(hdr, 0)
-            (length_crc,) = _U32.unpack_from(hdr, 8)
-            if verify and masked_crc32c(hdr[:8]) != length_crc:
-                raise RecordError(f"{path}: corrupt length crc at offset {offset}")
-            data = f.read(length)
-            footer = f.read(4)
-            if len(data) < length or len(footer) < 4:
-                raise RecordError(f"{path}: truncated record at offset {offset}")
-            if verify and masked_crc32c(data) != _U32.unpack(footer)[0]:
-                raise RecordError(f"{path}: corrupt data crc at offset {offset}")
-            yield data
-            offset += 12 + length + 4
+    with open(path, "rb") as f:
+        yield from _stream_records(f, path, verify)
 
 
 class RecordWriter:

@@ -55,6 +55,17 @@ _ALL = (
     Knob("TOS_FS_ROOTS", "str", "(unset: no mappings)",
          "scheme=root remote-filesystem mappings (os.pathsep-separated) "
          "carrying register_fs_root() into node processes."),
+    Knob("TOS_INGEST_AUTOTUNE", "bool", "1",
+         "DIRECT-mode ingest: autotune reader parallelism from decode-queue "
+         "occupancy (start at 1, grow while the consumer starves, shrink "
+         "when readers saturate); 0 pins TOS_INGEST_READERS threads."),
+    Knob("TOS_INGEST_PREFETCH", "int", "8",
+         "DIRECT-mode ingest: decoded-chunk prefetch depth (bounded queue "
+         "capacity) between the shard readers and the consuming map_fun."),
+    Knob("TOS_INGEST_READERS", "int", "4",
+         "DIRECT-mode ingest: parallel shard-reader threads per node (the "
+         "autotune ceiling; exact pool size when TOS_INGEST_AUTOTUNE=0; "
+         "0 = synchronous in-consumer reads, zero pipeline threads)."),
     Knob("TOS_MAX_PARTITION_ATTEMPTS", "int", "3",
          "Total feed attempts per partition (at-least-once ledger) before "
          "the job fails."),

@@ -448,6 +448,30 @@ def elastic_sum_batches(args, ctx):
                 manager.save(step, {"step": np.asarray(step)})
 
 
+def direct_record_counter(args, ctx):
+    """DIRECT-mode consumer: ``ctx.get_data_feed`` returns the ingest feed
+    (shard paths in, record payload bytes out).  Appends every record's
+    utf-8 payload to a per-(executor, incarnation) coverage file — the
+    at-least-once / exact-coverage probe for the direct-ingestion tests —
+    and publishes the job manifest + per-incarnation record count via
+    ``update_meta`` once the feed ends."""
+    feed = ctx.get_data_feed(train_mode=True)
+    cover = os.path.join(
+        args["out_dir"], f"seen_{ctx.executor_id}_inc{ctx.incarnation}.txt")
+    ctx.update_meta({"incarnation": ctx.incarnation})
+    n = 0
+    with open(cover, "a") as f:
+        while not feed.should_stop():
+            batch = feed.next_batch(args.get("batch_size", 16))
+            if not batch:
+                continue
+            f.write("".join(rec.decode() + "\n" for rec in batch))
+            f.flush()
+            n += len(batch)
+    ctx.update_meta({f"records_inc{ctx.incarnation}": n,
+                     "manifest": ctx.job_manifest()})
+
+
 def pipelined_consensus_consumer(args, ctx):
     """Feed consumer driving the PIPELINED end-of-data consensus by hand
     (vote -> "train step" -> resolve), for the death-mid-vote chaos tests.

@@ -319,6 +319,51 @@ def test_lock_closure_bodies_do_not_inherit_the_lock():
         """, f"{PKG}/node.py", "lock-discipline") == []
 
 
+# -- shard IO discipline ------------------------------------------------------
+
+
+def test_shard_io_fires_on_raw_binary_shard_open():
+    found = lint(
+        """
+        import gzip
+        def f(shard_path, part_file):
+            a = open(shard_path, "rb").read()
+            b = gzip.open("data/part-00001", mode="rb").read()
+            c = gzip.open(shard_path).read()   # gzip's DEFAULT mode is 'rb'
+            return a, b, c
+        """, f"{PKG}/somemod.py", "shard-io-discipline")
+    assert len(found) == 3
+    assert all("CRC" in f.message for f in found)
+
+
+def test_shard_io_fires_on_path_read_bytes():
+    found = lint(
+        """
+        from pathlib import Path
+        def f(shard):
+            return Path(shard).read_bytes()
+        """, f"{PKG}/somemod.py", "shard-io-discipline")
+    assert len(found) == 1 and "read_bytes" in found[0].anchor
+
+
+def test_shard_io_quiet_in_sanctioned_homes_and_on_non_shard_io():
+    src = """
+        def f(shard_path):
+            return open(shard_path, "rb").read()
+        """
+    assert lint(src, f"{PKG}/tfrecord.py", "shard-io-discipline") == []
+    assert lint(src, f"{PKG}/ingest/readers.py", "shard-io-discipline") == []
+    quiet = lint(
+        """
+        def f(shard_meta, config_path, shard_out):
+            a = open(shard_meta) .read()           # text mode: not a codec bypass
+            b = open(config_path, "rb").read()     # binary, but not shard-named
+            open(shard_out, "wb").write(b"x")      # writes are the writer's business
+            return a, b
+        """, f"{PKG}/somemod.py", "shard-io-discipline")
+    assert quiet == []
+
+
 # -- silent-except discipline -------------------------------------------------
 
 

@@ -1,0 +1,37 @@
+"""Tier-1 smoke for the committed ingest scaling bench (ISSUE 6 satellite):
+the bench machinery must keep producing EXACT record counts on a tiny shard
+set in every mode — a pipeline that loses or duplicates records must fail
+here, not silently skew BENCH_r08's MB/s."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_ingest  # noqa: E402
+
+
+@pytest.mark.parametrize("mode", ["direct", "direct_threaded", "streaming"])
+def test_bench_mode_exact_counts(tmp_path, mode):
+    paths, total_bytes = bench_ingest.prepare_shards(
+        str(tmp_path), num_shards=4, records_per_shard=24, record_bytes=512)
+    # _run_mode raises on any count mismatch — exactness is the assertion
+    result = bench_ingest._run_mode(mode, 2, paths, records_per_shard=24)
+    assert result["mb_per_s"] > 0
+    assert result["num_nodes"] == 2
+    assert result["mode"] == mode
+
+
+def test_bench_quick_table_shape(tmp_path):
+    results = bench_ingest.bench(quick=True, fanout=(1,), repeats=1,
+                                 data_dir=str(tmp_path / "shards"))
+    for mode in ("direct", "direct_threaded", "streaming"):
+        assert len(results[mode]) == 1
+        assert results[mode][0]["mb_per_s"] > 0
+        assert results[f"{mode}_scaling"] == [1.0]
+    out = bench_ingest.markdown_table(results)
+    assert "direct" in out and "streaming" in out
