@@ -1,6 +1,6 @@
 """The toslint checkers — this codebase's invariants, mechanically enforced.
 
-Seven disciplines, each born from a class of bug the elastic control/data
+Eight disciplines, each born from a class of bug the elastic control/data
 plane makes likely (see ISSUE 2 / ROADMAP):
 
 - ``knob-discipline``: every ``TOS_*`` env read goes through
@@ -15,6 +15,9 @@ plane makes likely (see ISSUE 2 / ROADMAP):
 - ``lock-discipline``: in the threaded modules, attributes mutated both
   under and outside ``self._lock`` (a data race until proven otherwise),
   and blocking calls made while a lock is held (a convoy/deadlock seed).
+- ``reactor-discipline``: in the serving frontend's reactor classes, no
+  blocking calls (sleeps, joins, blocking socket loops, lock waits) inside
+  the reactor callback scope — one blocking call stalls EVERY connection.
 - ``silent-except``: ``except ...: pass`` without a log line or an explicit
   ``# toslint: allow-silent(<reason>)`` pragma — silence is how invariants
   rot.
@@ -324,6 +327,8 @@ _THREADED_BASENAMES = frozenset({
     # the online-serving subsystem is thread-per-replica + flush/watch
     # threads throughout — same race classes, same discipline
     "gateway.py", "batcher.py", "router.py",
+    # the reactor frontend: completion threads hand replies to the reactor
+    "frontend.py",
     # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer
     "readers.py", "feed.py",
 })
@@ -456,6 +461,67 @@ class LockDisciplineChecker(Checker):
         if isinstance(func.value, ast.Constant):  # "".join / b"".join
             return True
         return mod.imports.qualify(func) in _SAFE_JOIN_QUALS
+
+
+# -- 3b. reactor discipline ---------------------------------------------------
+
+# The serving frontend multiplexes EVERY gateway connection on one reactor
+# thread; a single blocking call in its callback scope stalls the whole
+# endpoint (every client's p99, not one).  Scope contract, mirrored in
+# serving/frontend.py's threading docstring: every method of a ``*Reactor*``
+# class runs on (or must be safe on) the reactor thread, EXCEPT ``__init__``
+# (pre-publication) and ``stop`` (the caller-thread join point).
+_REACTOR_PATH_SUFFIXES = ("serving/frontend.py",)
+_REACTOR_EXEMPT_METHODS = frozenset({"__init__", "stop"})
+# Calls that block: sleeps/joins, the blocking socket-loop helpers
+# (recv_exact*/sendall/sendmsg_all loop until done — the reactor must use
+# one-shot recv/sendmsg_some), dials, and lock/event waits.  Non-blocking
+# recv/accept/select on the reactor's own non-blocking fds stay legal.
+_REACTOR_BLOCKING = frozenset({
+    "sleep", "join", "recv_exact", "recv_exact_into", "sendall",
+    "sendmsg_all", "connect_with_backoff", "wait", "acquire",
+})
+
+
+@register_checker
+class ReactorDisciplineChecker(Checker):
+    """No blocking calls inside the serving reactor's callback scope."""
+
+    id = "reactor-discipline"
+    hint = ("the reactor thread serves every connection: park partial I/O "
+            "on the write queue / decode buffer and let the selector re-arm "
+            "it, or hand the work to a completion thread")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if not mod.path.endswith(_REACTOR_PATH_SUFFIXES):
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if not (isinstance(node, ast.ClassDef) and "Reactor" in node.name):
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if item.name in _REACTOR_EXEMPT_METHODS:
+                    continue
+                # _scoped_walk scopes include the class node itself
+                yield from self._scan_method(mod, scope, item)
+
+    def _scan_method(self, mod: ModuleSource, scope: tuple[str, ...],
+                     fn: ast.AST) -> Iterator[Finding]:
+        qual = f"{_qual(scope)}.{fn.name}"
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name not in _REACTOR_BLOCKING:
+                continue
+            if name == "join" and LockDisciplineChecker._safe_join(mod, node):
+                continue
+            yield Finding(
+                self.id, mod.path, node.lineno,
+                f"blocking call {name}() inside reactor callback scope "
+                f"({qual}) — it stalls every gateway connection at once",
+                self.hint, f"{qual}@block:{name}")
 
 
 # -- 4. silent-exception discipline ------------------------------------------

@@ -128,22 +128,29 @@ def _vec_parts(obj: Any) -> tuple[bytes, list]:
     return body, raws
 
 
-def _send(sock: socket.socket, obj: Any, wire: int = 1) -> None:
+def frame_parts(obj: Any, wire: int = 1) -> list:
+    """Buffer list for ONE wire frame of ``obj`` (header, body[, raw
+    buffers]); sending the list in order IS the frame.  Shared by the
+    blocking ``_send`` below and the serving reactor, whose non-blocking
+    writes park leftover views on a per-connection queue instead of
+    looping — the zero-copy property (out-of-band buffers scatter-gather
+    straight from their own memory) is identical on both paths."""
     if wire >= 2:
         body, raws = _vec_parts(obj)
         header = bytearray(_LEN.pack(_VEC_BIT | (len(raws) + 1)))
         header += _LEN.pack(len(body))
-        total = len(body)
         for r in raws:
             header += _LEN.pack(r.nbytes)
-            total += r.nbytes
-        _sendmsg_all(sock, [header, body, *raws])
-        telemetry.counter("dataplane.tx_bytes").inc(total + len(header))
-        telemetry.counter("dataplane.tx_frames").inc()
-        return
+        return [header, body, *raws]
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    _sendmsg_all(sock, [_LEN.pack(len(data)), data])
-    telemetry.counter("dataplane.tx_bytes").inc(8 + len(data))
+    return [_LEN.pack(len(data)), data]
+
+
+def _send(sock: socket.socket, obj: Any, wire: int = 1) -> None:
+    parts = frame_parts(obj, wire)
+    _sendmsg_all(sock, parts)
+    telemetry.counter("dataplane.tx_bytes").inc(
+        sum(memoryview(p).nbytes for p in parts))
     telemetry.counter("dataplane.tx_frames").inc()
 
 

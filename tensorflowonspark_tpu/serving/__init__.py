@@ -3,15 +3,22 @@
 The request/response subsystem layered on the existing cluster (the batch
 stack's missing half — see ``gateway.py`` for the architecture):
 
-- :class:`ServingGateway` / :class:`GatewayClient` — driver-side handle
-  (``cluster.serve(export_dir)``) and the TCP wire caller;
+- :class:`ServingGateway` — driver-side handle (``cluster.serve``);
+- :class:`ReactorFrontend` — the single-thread ``selectors`` reactor TCP
+  endpoint: pipelined multiplexed connections, zero-copy out-of-order
+  responses, fast-fail backpressure (``frontend.py``);
+- :class:`GatewayClient` / :class:`GatewayClientPool` — the pipelined
+  remote caller (many id-tagged requests outstanding per socket) and a
+  connection pool for closed-loop caller fleets;
 - :class:`MicroBatcher` — dynamic micro-batching + admission control;
 - :class:`ReplicaRouter` — least-outstanding routing, death retry,
   incarnation-fenced recovery;
 - :func:`serving_loop` — the resident node map_fun.
 
 Tuning knobs: ``TOS_SERVE_QUEUE``, ``TOS_SERVE_MAX_BATCH``,
-``TOS_SERVE_MAX_DELAY_MS``, ``TOS_SERVE_TIMEOUT`` (see the README table).
+``TOS_SERVE_MAX_DELAY_MS``, ``TOS_SERVE_TIMEOUT``,
+``TOS_SERVE_HANDSHAKE_TIMEOUT``, ``TOS_SERVE_CONN_OUTSTANDING`` (see the
+README table).
 """
 
 from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
@@ -22,9 +29,12 @@ from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
     ServeQueueFull,
     ServeTimeout,
 )
+from tensorflowonspark_tpu.serving.frontend import ReactorFrontend  # noqa: F401
 from tensorflowonspark_tpu.serving.gateway import (  # noqa: F401
     CTL_KEY,
     GatewayClient,
+    GatewayClientPool,
+    LegacyGatewayClient,
     ServingGateway,
 )
 from tensorflowonspark_tpu.serving.loop import serving_loop  # noqa: F401

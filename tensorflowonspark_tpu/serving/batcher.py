@@ -58,7 +58,8 @@ class _Request:
     """One predict call: rows in, results (or one error) out, exactly once."""
 
     __slots__ = ("rows", "results", "remaining", "offset", "error",
-                 "event", "deadline", "t_submit", "dispatched_at")
+                 "event", "deadline", "t_submit", "dispatched_at",
+                 "callbacks")
 
     def __init__(self, rows: list, deadline: float):
         self.rows = rows
@@ -70,6 +71,9 @@ class _Request:
         self.deadline = deadline
         self.t_submit = _monotonic()
         self.dispatched_at: float | None = None
+        # done callbacks (the reactor frontend's completion path); invoked
+        # exactly once, never with the batcher lock held
+        self.callbacks: list = []
 
 
 class MicroBatch:
@@ -137,6 +141,9 @@ class MicroBatcher:
         self._queue: collections.deque[_Request] = collections.deque()
         self._rows_queued = 0
         self._closed = False
+        # requests finished while the lock was held, their callbacks not yet
+        # run — drained by _fire_done() after every lock release
+        self._done_pending: list[_Request] = []
         self._depth = telemetry.gauge("serve.queue_depth")
         self._thread = threading.Thread(target=self._flush_loop, daemon=True,
                                         name="serve-batcher")
@@ -149,34 +156,76 @@ class MicroBatcher:
         rows = list(rows)
         if not rows:
             raise ValueError("predict needs at least one row")
+        res = self.submit_many([(rows, deadline, None)])[0]
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def submit_many(self, entries: list) -> list:
+        """Bulk admission for the reactor: admit ``[(rows, deadline,
+        done_cb), ...]`` under ONE lock acquisition with ONE flush-loop
+        notify — a pipelined burst decoded in one read pass costs one
+        critical section, not one per request.  Returns one entry per
+        input: the admitted request, or the admission error instance
+        (:class:`ServeClosed` / :class:`ServeQueueFull`) for refusals.
+        Callbacks are attached inside the lock, so a request can never
+        resolve before its callback is registered."""
+        out: list = []
+        accepted = rows_total = 0
         with self._cond:
-            if self._closed:
-                raise ServeClosed("serving gateway is closed")
-            if len(self._queue) >= self.queue_limit:
-                telemetry.counter("serve.rejected_total").inc()
-                raise ServeQueueFull(
-                    f"request queue full ({self.queue_limit} queued); "
-                    "retry later or add replicas")
-            req = _Request(rows, deadline)
-            self._queue.append(req)
-            self._rows_queued += len(rows)
-            self._depth.set(len(self._queue))
-            self._cond.notify_all()
-        telemetry.counter("serve.requests_total").inc()
-        telemetry.counter("serve.rows_total").inc(len(rows))
-        return req
+            for rows, deadline, done_cb in entries:
+                if self._closed:
+                    out.append(ServeClosed("serving gateway is closed"))
+                    continue
+                if len(self._queue) >= self.queue_limit:
+                    telemetry.counter("serve.rejected_total").inc()
+                    out.append(ServeQueueFull(
+                        f"request queue full ({self.queue_limit} queued); "
+                        "retry later or add replicas"))
+                    continue
+                req = _Request(rows, deadline)
+                if done_cb is not None:
+                    req.callbacks.append(done_cb)
+                self._queue.append(req)
+                self._rows_queued += len(rows)
+                accepted += 1
+                rows_total += len(rows)
+                out.append(req)
+            if accepted:
+                self._depth.set(len(self._queue))
+                self._cond.notify_all()
+        if accepted:
+            telemetry.counter("serve.requests_total").inc(accepted)
+            telemetry.counter("serve.rows_total").inc(rows_total)
+        return out
 
     def await_request(self, req: _Request) -> list:
         """Block until the request resolves or its deadline passes; returns
         results or raises the request's single error."""
         if not req.event.wait(max(0.0, req.deadline - _monotonic())):
-            self._expire(req)
-            req.event.wait()  # _expire (or a racing completion) resolved it
+            self.expire(req)
+            req.event.wait()  # expire (or a racing completion) resolved it
         if req.error is not None:
             raise req.error
         return req.results
 
-    def _expire(self, req: _Request) -> None:
+    def add_done_callback(self, req: _Request, fn) -> None:
+        """Register ``fn(req)`` to run once the request resolves (results or
+        error) — the reactor frontend's completion hook, so no thread ever
+        blocks in ``await_request`` for a wire request.  Runs on whichever
+        thread resolves the request (router worker, flush loop, expiry,
+        close), never with the batcher lock held; when the request already
+        resolved, runs immediately on the calling thread."""
+        with self._cond:
+            if not req.event.is_set():
+                req.callbacks.append(fn)
+                return
+        fn(req)
+
+    def expire(self, req: _Request) -> None:
+        """Resolve ``req`` with :class:`ServeTimeout` unless completion won
+        the race — idempotent; callable from the waiter thread
+        (``await_request``) or the reactor's deadline sweep."""
         with self._cond:
             if req.event.is_set():
                 return  # completion won the race
@@ -190,6 +239,29 @@ class MicroBatcher:
             self._finish_locked(req, ServeTimeout(
                 f"request deadline expired after "
                 f"{_monotonic() - req.t_submit:.3f}s"))
+        self._fire_done()
+
+    def cancel(self, req: _Request, error: Exception | None = None) -> None:
+        """Resolve ``req`` with ``error`` (default :class:`ServeClosed`)
+        without waiting for results: queued rows — including a spanning
+        request's tail — are pulled out so they never reach a replica or
+        hold an admission slot; a slice already in flight completes on its
+        replica and is discarded at scatter time (the set event).  The
+        frontend calls this when a client disconnects with requests
+        outstanding."""
+        with self._cond:
+            if req.event.is_set():
+                return
+            try:
+                self._queue.remove(req)
+                self._rows_queued -= len(req.rows) - req.offset
+                self._depth.set(len(self._queue))
+            except ValueError:  # toslint: allow-silent(already pulled into an in-flight batch; the late results are discarded at scatter time)
+                pass
+            telemetry.counter("serve.cancelled_total").inc()
+            self._finish_locked(req, error or ServeClosed(
+                "request cancelled (client gone)"))
+        self._fire_done()
 
     # -- flush loop ----------------------------------------------------------
 
@@ -197,8 +269,10 @@ class MicroBatcher:
         while True:
             batch: MicroBatch | None = None
             with self._cond:
-                while not self._closed:
+                while not self._closed and batch is None:
                     self._drop_expired_locked()
+                    if self._done_pending:
+                        break  # run expiry callbacks before waiting again
                     if self._queue and not self._pause_fn():
                         age = _monotonic() - self._queue[0].t_submit
                         ripe = (self._rows_queued >= self.max_batch
@@ -213,9 +287,12 @@ class MicroBatcher:
                                         else min(self.max_delay - age, 0.05))
                     else:
                         self._cond.wait(0.05)
-                if batch is None:
-                    return  # closed; close() already resolved the queue
-            self._dispatch(batch)
+                closed = self._closed
+            self._fire_done()
+            if batch is not None:
+                self._dispatch(batch)
+            elif closed:
+                return  # close() already resolved the queue
 
     def _drop_expired_locked(self) -> None:
         now = _monotonic()
@@ -274,6 +351,7 @@ class MicroBatcher:
                 if req.remaining <= 0:
                     self._finish_locked(req, None)
             self._cond.notify_all()  # capacity freed: the flush loop may act
+        self._fire_done()
 
     def fail_batch(self, batch: MicroBatch, error: Exception) -> None:
         """Resolve every waiter of a failed batch with one error.  A
@@ -292,6 +370,7 @@ class MicroBatcher:
                             pass
             self._depth.set(len(self._queue))
             self._cond.notify_all()
+        self._fire_done()
 
     def _finish_locked(self, req: _Request, error: Exception | None) -> None:
         req.error = error
@@ -299,6 +378,25 @@ class MicroBatcher:
             telemetry.histogram("serve.request_secs").observe(
                 _monotonic() - req.t_submit)
         req.event.set()
+        if req.callbacks:
+            self._done_pending.append(req)
+
+    def _fire_done(self) -> None:
+        """Run done callbacks of requests resolved under the lock — outside
+        it, so a callback may safely re-enter the batcher (submit / cancel)
+        without deadlocking."""
+        while True:
+            with self._cond:
+                if not self._done_pending:
+                    return
+                pending, self._done_pending = self._done_pending, []
+            for req in pending:
+                callbacks, req.callbacks = req.callbacks, []
+                for fn in callbacks:
+                    try:
+                        fn(req)
+                    except Exception:  # noqa: BLE001 - one bad callback must not orphan the rest
+                        logger.exception("serve done-callback failed")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -314,4 +412,5 @@ class MicroBatcher:
             self._rows_queued = 0
             self._depth.set(0)
             self._cond.notify_all()
+        self._fire_done()
         self._thread.join(timeout=10.0)

@@ -16,10 +16,14 @@ Three layers, composed here:
 - routing + failover: :class:`~.router.ReplicaRouter` (least-outstanding
   replica choice, one retry on a live replica after a death, incarnation-
   fenced recovery);
-- the wire endpoint: a threaded TCP frontend speaking the data plane's
-  own framing — HMAC handshake on the cluster authkey, then protocol-5
-  zero-copy v2 frames (numpy rows/results travel as out-of-band buffers).
-  :class:`GatewayClient` is the matching remote caller.
+- the wire endpoint: :class:`~.frontend.ReactorFrontend` — a single-thread
+  ``selectors`` reactor speaking the data plane's framing (HMAC handshake
+  on the cluster authkey, then protocol-5 zero-copy v2 frames) with
+  request *pipelining*: many id-tagged requests outstanding per socket,
+  responses written back out of order as their micro-batches complete.
+  :class:`GatewayClient` is the matching pipelined remote caller;
+  :class:`GatewayClientPool` fans closed-loop callers over several
+  sockets.
 
 Hot reload: a version watcher polls ``export_dir``; when a newer export
 lands, in-flight batches drain, every replica swaps its bundle via a
@@ -32,7 +36,6 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
-import socket
 import threading
 from time import monotonic as _monotonic
 from typing import Any, Sequence
@@ -47,15 +50,14 @@ from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401 - CTL_KEY re-e
     ServeQueueFull,
     ServeTimeout,
 )
+from tensorflowonspark_tpu.serving.frontend import ReactorFrontend
 from tensorflowonspark_tpu.serving.router import ReplicaRouter
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int
 from tensorflowonspark_tpu.utils.net import (
     bound_socket,
     connect_with_backoff,
     hmac_handshake_client,
-    hmac_handshake_server,
     local_ip,
-    set_nodelay,
 )
 from tensorflowonspark_tpu.utils.paths import resolve_uri
 
@@ -80,6 +82,8 @@ class ServingGateway:
                  queue_limit: int | None = None,
                  default_timeout: float | None = None,
                  listen: bool = True, listen_host: str = "",
+                 handshake_timeout: float | None = None,
+                 max_conn_outstanding: int | None = None,
                  reload_poll_secs: float = 2.0):
         self.export_dir = export_dir
         self.max_batch = (int(max_batch) if max_batch is not None
@@ -118,18 +122,22 @@ class ServingGateway:
                 target=self._watch_loop, args=(float(reload_poll_secs),),
                 daemon=True, name="serve-version-watch")
             self._watch_thread.start()
-        # TCP frontend (the wire endpoint).  Default listen_host="" binds
-        # ALL interfaces — remote callers are the point, and every
-        # connection must pass the HMAC handshake on the cluster authkey;
-        # pass listen_host="127.0.0.1" to confine it to loopback.
-        self._listener: socket.socket | None = None
+        # TCP frontend (the wire endpoint): a single-thread reactor serving
+        # every connection — see serving/frontend.py.  Default
+        # listen_host="" binds ALL interfaces — remote callers are the
+        # point, and every connection must pass the HMAC handshake on the
+        # cluster authkey; pass listen_host="127.0.0.1" to confine it.
+        self._frontend: ReactorFrontend | None = None
         self._endpoint: tuple[str, int] | None = None
         if listen:
-            self._listener = bound_socket(listen_host)
-            port = self._listener.getsockname()[1]
+            listener = bound_socket(listen_host)
+            port = listener.getsockname()[1]
             self._endpoint = (listen_host or local_ip() or "127.0.0.1", port)
-            threading.Thread(target=self._accept_loop, daemon=True,
-                             name="serve-frontend").start()
+            self._frontend = ReactorFrontend(
+                listener, self._authkey, self._batcher,
+                default_timeout=self.default_timeout,
+                handshake_timeout=handshake_timeout,
+                max_conn_outstanding=max_conn_outstanding)
         logger.info("serving gateway up: %d replica(s), max_batch=%d, "
                     "max_delay=%.1fms, queue=%d%s",
                     len(cluster._feed_ids), self.max_batch, delay_ms,
@@ -220,55 +228,6 @@ class ServingGateway:
                 else:
                     self._export_sig = cur
 
-    # -- TCP frontend --------------------------------------------------------
-
-    def _accept_loop(self) -> None:
-        while True:
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return  # listener closed
-            set_nodelay(conn)  # small request/reply frames: Nagle adds ~40ms
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True, name="serve-conn").start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        try:
-            if not hmac_handshake_server(conn, self._authkey):
-                logger.warning("rejected gateway connection: bad authkey")
-                return
-            while True:
-                msg = _recv(conn)
-                reply = self._handle(msg)
-                _send(conn, reply, wire=2)
-                if msg[0] == "close":
-                    return
-        except (ConnectionError, OSError, EOFError):
-            return
-        finally:
-            conn.close()
-
-    def _handle(self, msg: tuple) -> tuple:
-        op = msg[0]
-        if op == "predict":
-            rows, timeout = msg[1], (msg[2] if len(msg) > 2 else None)
-            try:
-                return ("ok", self.predict(list(rows), timeout))
-            except ServeQueueFull as e:
-                return ("err", "unavailable", str(e))
-            except ServeTimeout as e:
-                return ("err", "deadline", str(e))
-            except ServeClosed as e:
-                return ("err", "closed", str(e))
-            except Exception as e:  # noqa: BLE001 - surface to the caller
-                logger.exception("gateway predict failed")
-                return ("err", "internal", f"{type(e).__name__}: {e}")
-        if op == "ping":
-            return ("ok", "pong")
-        if op == "close":
-            return ("ok",)
-        return ("err", "internal", f"unknown op {op!r}")
-
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
@@ -280,23 +239,284 @@ class ServingGateway:
         self._watch_stop.set()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=10.0)
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:  # toslint: allow-silent(closing the listener is what stops the accept loop; a racing second close is fine)
-                pass
+        # router + batcher first: closing them resolves every request (the
+        # last completion producers), so the frontend's reactor — still
+        # draining — delivers the final error replies, and stop() can then
+        # safely retire the wake pipe with no racing writers left.
         self._router.close()
         self._batcher.close()
+        if self._frontend is not None:
+            self._frontend.stop()
+
+
+class _GatewayFuture:
+    """Async handle for one pipelined :class:`GatewayClient` request:
+    ``result()`` blocks until the id-matched reply arrives and returns the
+    results or raises the mapped gateway error."""
+
+    __slots__ = ("_event", "_reply", "_error", "_timeout", "_deadline")
+
+    def __init__(self, timeout: float):
+        self._event = threading.Event()
+        self._reply: tuple | None = None
+        self._error: Exception | None = None
+        self._timeout = timeout
+        # client-side hang detector: the gateway answers every accepted
+        # request by its server-side deadline, so a reply this overdue
+        # means the connection is dead, not slow
+        self._deadline = _monotonic() + timeout + 30.0
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _resolve(self, reply: tuple) -> None:
+        self._reply = reply
+        self._event.set()
+
+    def _resolve_error(self, error: Exception) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: float | None = None):
+        """The request's results (or raises its error).  ``timeout`` is a
+        client-side backstop on top of the server-enforced deadline — the
+        gateway answers every accepted request, so this should only fire
+        when the server is unreachable (then the receiver poisons the
+        client and resolves every future with the connection error)."""
+        budget = timeout if timeout is not None else self._timeout + 30.0
+        if not self._event.wait(budget):
+            raise ServeTimeout(
+                f"no gateway reply within the client-side budget ({budget:.1f}s)")
+        if self._error is not None:
+            raise self._error
+        reply = self._reply
+        if isinstance(reply, tuple) and reply and reply[0] == "ok":
+            return reply[1]
+        if isinstance(reply, tuple) and len(reply) >= 3 and reply[0] == "err":
+            raise _ERR_TYPES.get(reply[1], RuntimeError)(reply[2])
+        raise RuntimeError(f"malformed gateway reply: {reply!r}")
 
 
 class GatewayClient:
-    """Remote caller for a gateway's TCP endpoint.
+    """Pipelined remote caller for a gateway's TCP endpoint.
 
-    Same wire stack as the data plane: HMAC challenge-response on the
-    cluster authkey, then v2 (protocol-5, zero-copy) frames.  One
-    request/reply in flight per connection — open one client per
-    closed-loop caller (the bench does), or several for pipelining.
+    Same wire stack as the data plane — HMAC challenge-response on the
+    cluster authkey, then v2 (protocol-5, zero-copy) frames — but
+    *multiplexed*: every request carries a client-assigned id, many
+    requests stay outstanding on the one socket (``predict_async``), and a
+    receiver thread resolves futures as id-tagged replies arrive, in
+    whatever order the gateway finishes them.  ``predict`` is the
+    closed-loop convenience (``predict_async(...).result()``).
+
+    ``max_outstanding`` (0 = unbounded) caps the client-side pipeline
+    depth with a semaphore — the gateway additionally enforces its own
+    per-connection cap (``TOS_SERVE_CONN_OUTSTANDING``) with fast-fail
+    ``ServeQueueFull`` replies.
     """
+
+    def __init__(self, host: str, port: int, authkey: bytes, *,
+                 connect_timeout: float = 30.0, call_timeout: float = 120.0,
+                 max_outstanding: int = 0):
+        self._sock = connect_with_backoff((host, port),
+                                          timeout=connect_timeout)
+        self._sock.settimeout(call_timeout)
+        if not hmac_handshake_client(self._sock, authkey):
+            self._sock.close()
+            raise RuntimeError("gateway auth handshake failed")
+        self._call_timeout = call_timeout
+        # frame-write serializer: interleaved sendmsg from two threads would
+        # interleave frame bytes (same deliberate hold-lock-across-I/O
+        # pattern as DataClient._call; baselined in analysis/baseline.json)
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()  # id counter + pending map + closed
+        self._pending: dict[int, _GatewayFuture] = {}
+        self._next_id = 1
+        self._closed = False
+        self._sem = (threading.Semaphore(max_outstanding)
+                     if max_outstanding > 0 else None)
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True,
+                                    name="gateway-client-rx")
+        self._rx.start()
+
+    # -- wire ----------------------------------------------------------------
+
+    def _start(self, msg: tuple, timeout: float) -> _GatewayFuture:
+        """Register a future under a fresh id and send ``msg + (id,)``."""
+        if self._sem is not None:
+            self._sem.acquire()
+        with self._lock:
+            if self._closed:
+                if self._sem is not None:
+                    self._sem.release()
+                raise ServeClosed("gateway client is closed")
+            rid = self._next_id
+            self._next_id += 1
+            fut = _GatewayFuture(timeout)
+            self._pending[rid] = fut
+        try:
+            with self._send_lock:
+                _send(self._sock, (*msg, rid), wire=2)
+        except (TimeoutError, OSError) as e:
+            self._poison(e)
+            raise
+        return fut
+
+    def _recv_loop(self) -> None:
+        import select as _select
+
+        try:
+            while True:
+                # Wait for readability OUTSIDE the frame reader: a timeout
+                # here consumes no stream bytes, so an idle (or
+                # about-to-reply) connection is never poisoned by quiet
+                # time — only a genuinely overdue pending request is.
+                # Once bytes are ready, _recv runs with call_timeout armed
+                # on the socket: a stall MID-frame at that scale really is
+                # a dead peer.
+                while True:
+                    ready, _, _ = _select.select([self._sock], [], [], 1.0)
+                    if ready:
+                        break
+                    self._check_overdue()
+                reply = _recv(self._sock)
+                if not isinstance(reply, tuple) or not reply:
+                    continue
+                if reply[0] == "okm":
+                    # multi-reply frame: one batch scatter's worth of
+                    # (rid, "ok"/"err", ...) entries coalesced by the
+                    # reactor; entry[1:] is the single-reply tuple shape
+                    for entry in reply[1]:
+                        self._resolve_one(entry[0], tuple(entry[1:]))
+                    continue
+                rid = (reply[-1] if len(reply) >= 2
+                       and isinstance(reply[-1], int) else None)
+                if rid is None:
+                    continue  # close ack / unsolicited frame
+                self._resolve_one(rid, reply[:-1])
+        except (ConnectionError, OSError, EOFError, ValueError) as e:
+            # ValueError: select() on a socket another thread just closed
+            self._poison(e)
+
+    def _check_overdue(self) -> None:
+        now = _monotonic()
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("gateway client closed")
+            overdue = any(f._deadline <= now for f in self._pending.values())
+        if overdue:
+            raise ConnectionError(
+                "no gateway reply well past the request deadline; "
+                "connection presumed dead")
+
+    def _resolve_one(self, rid, payload: tuple) -> None:
+        with self._lock:
+            fut = self._pending.pop(rid, None)
+        if fut is not None:
+            if self._sem is not None:
+                self._sem.release()
+            fut._resolve(payload)
+
+    def _poison(self, error: Exception) -> None:
+        """Terminal: fail every pending future and close the socket.  A
+        stream that errored may hold partial frames — there is no way to
+        resync, so the client is done (mirror of DataClient._call)."""
+        with self._lock:
+            was_closed, self._closed = self._closed, True
+            pending, self._pending = self._pending, {}
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        err = (ServeClosed("gateway client closed") if was_closed
+               else ConnectionError(f"gateway connection lost: {error}"))
+        for fut in pending.values():
+            if self._sem is not None:
+                self._sem.release()
+            fut._resolve_error(err)
+
+    # -- API -----------------------------------------------------------------
+
+    def predict_async(self, rows: Sequence[Any],
+                      timeout: float | None = None) -> _GatewayFuture:
+        """Send one predict request; returns a future resolved by reply id.
+        Many may be outstanding — that is the point."""
+        t = float(timeout) if timeout is not None else self._call_timeout
+        return self._start(("predict", list(rows), timeout), t)
+
+    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+        """Round-trip one predict request; mirrors ``ServingGateway.predict``
+        including its error types."""
+        return self.predict_async(rows, timeout).result()
+
+    def outstanding(self) -> int:
+        """Requests currently awaiting replies (the pool's load signal)."""
+        with self._lock:
+            return len(self._pending)
+
+    def ping(self, timeout: float = 10.0) -> bool:
+        try:
+            return self._start(("ping",), timeout).result(timeout) == "pong"
+        except (ConnectionError, OSError, ServeTimeout):
+            return False
+
+    def close(self) -> None:
+        """Best-effort close op, then poison: outstanding futures resolve
+        with ``ServeClosed``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            with self._send_lock:
+                _send(self._sock, ("close",), wire=2)
+        except OSError:  # toslint: allow-silent(best-effort teardown; the poison below closes the socket regardless)
+            pass
+        self._poison(ServeClosed("client closed"))
+        self._rx.join(timeout=5.0)
+
+
+class GatewayClientPool:
+    """A fixed pool of pipelined :class:`GatewayClient` connections.
+
+    Closed-loop callers (one request in flight per caller thread) cannot
+    exploit pipelining on their own; the pool gives a fleet of them
+    connection reuse + multiplexing for free: each call goes to the pooled
+    connection with the fewest outstanding requests, so T caller threads
+    share ``size`` sockets instead of opening T.  All methods are
+    thread-safe; every client maps its own futures by id, so interleaving
+    is free of head-of-line blocking at the protocol level.
+    """
+
+    def __init__(self, host: str, port: int, authkey: bytes, *,
+                 size: int = 4, **client_kwargs):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        self._clients = [GatewayClient(host, port, authkey, **client_kwargs)
+                         for _ in range(size)]
+
+    def _pick(self) -> GatewayClient:
+        return min(self._clients, key=lambda c: c.outstanding())
+
+    def predict_async(self, rows: Sequence[Any],
+                      timeout: float | None = None) -> _GatewayFuture:
+        return self._pick().predict_async(rows, timeout)
+
+    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+        return self.predict_async(rows, timeout).result()
+
+    def ping(self) -> bool:
+        return all(c.ping() for c in self._clients)
+
+    def close(self) -> None:
+        for c in self._clients:
+            with contextlib.suppress(Exception):
+                c.close()
+
+
+class LegacyGatewayClient:
+    """The pre-reactor one-request-per-round-trip caller: id-less predict
+    frames, blocking request/reply on one socket.  Kept as the wire-
+    compatibility reference — the reactor must answer these clients
+    forever (depth 1, id-less replies) — and for minimal embedded callers
+    that want no background thread."""
 
     def __init__(self, host: str, port: int, authkey: bytes, *,
                  connect_timeout: float = 30.0, call_timeout: float = 120.0):
@@ -324,8 +544,6 @@ class GatewayClient:
                 raise
 
     def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
-        """Round-trip one predict request; mirrors ``ServingGateway.predict``
-        including its error types."""
         reply = self._call(("predict", list(rows), timeout))
         if isinstance(reply, tuple) and reply and reply[0] == "ok":
             return reply[1]

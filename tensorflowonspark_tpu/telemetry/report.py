@@ -111,6 +111,23 @@ def debug_dump(aggregated: dict) -> str:
     return "\n".join(lines)
 
 
+def _gauge_max(aggregated: dict, name: str):
+    """Largest per-node value of a gauge, or None when no node reports it
+    (gauges stay per-node in the aggregate; for the serving frontend's
+    connection/outstanding gauges the driver is the only reporter, so max
+    IS the value)."""
+    vals = [snap["gauges"][name]
+            for snap in (aggregated.get("nodes") or {}).values()
+            if name in (snap.get("gauges") or {})]
+    return max(vals) if vals else None
+
+
+def _hist_ms(aggregated: dict, name: str, q: str):
+    """A merged histogram's percentile in milliseconds, or None."""
+    v = ((aggregated.get("histograms") or {}).get(name) or {}).get(q)
+    return round(v * 1e3, 3) if v is not None else None
+
+
 def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
                      extras: dict | None = None) -> dict:
     """End-of-run JSON document: the aggregate + derived headline numbers.
@@ -122,6 +139,29 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
     counters = aggregated.get("counters") or {}
     rx_bytes = counters.get("dataplane.rx_bytes")
     ingest_bytes = counters.get("ingest.bytes_read")
+    serve_requests = counters.get("serve.requests_total")
+    serving = None
+    if serve_requests:
+        # serving headlines: gateway qps/latency plus the reactor
+        # frontend's health next to them (connections, pipelining depth,
+        # frame counts, loop lag) — the wire endpoint is a single thread,
+        # so its loop-lag p99 is the first thing to check when TCP p99
+        # diverges from in-process
+        serving = {
+            "requests_total": serve_requests,
+            "qps": (round(serve_requests / wall_secs, 1)
+                    if wall_secs else None),
+            "request_p50_ms": _hist_ms(aggregated, "serve.request_secs", "p50"),
+            "request_p99_ms": _hist_ms(aggregated, "serve.request_secs", "p99"),
+            "frontend_frames_in": counters.get("serve.frontend.frames_in"),
+            "frontend_frames_out": counters.get("serve.frontend.frames_out"),
+            "frontend_connections_open": _gauge_max(
+                aggregated, "serve.frontend.connections"),
+            "frontend_outstanding_requests": _gauge_max(
+                aggregated, "serve.frontend.outstanding"),
+            "frontend_loop_lag_p99_ms": _hist_ms(
+                aggregated, "serve.frontend.loop_lag_secs", "p99"),
+        }
     report: dict[str, Any] = {
         "schema": "tos-run-report-v1",
         "written_at": time.time(),
@@ -138,6 +178,7 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
         "records_ingested": counters.get("ingest.records_read"),
         "rows_fed": counters.get("dataplane.rows_in"),
         "rows_consumed": counters.get("feed.rows_consumed"),
+        "serving": serving,
         "restarts_total": counters.get("elastic.restarts_total", 0),
         "faults_injected": counters.get("faultinject.injected_total", 0),
         "counters": counters,

@@ -107,6 +107,18 @@ _ALL = (
          "Cap on concurrent chunk SENDS across all node connections in "
          "train()/inference() (permit per chunk, never held across a "
          "partition); 0 = unlimited."),
+    Knob("TOS_SERVE_CONN_OUTSTANDING", "int", "128",
+         "Serving frontend: max pipelined requests outstanding per client "
+         "connection; excess requests get the fast-fail 'unavailable' "
+         "reply instead of queuing."),
+    Knob("TOS_SERVE_HANDSHAKE_TIMEOUT", "float", "5",
+         "Serving frontend: seconds a new connection may take to finish "
+         "the HMAC handshake before the reactor reaps it (slow-loris "
+         "protection)."),
+    Knob("TOS_SERVE_SWITCH_INTERVAL", "float", "1 (milliseconds)",
+         "GIL switch interval (ms) the serving frontend sets for the "
+         "driver process while the reactor runs; CPython's 5ms default "
+         "convoys reactor/batcher/router handoffs (pass 5 to opt out)."),
     Knob("TOS_SERVE_QUEUE", "int", "256",
          "Serving gateway admission control: max queued (not yet "
          "dispatched) predict requests before fast-fail rejection "

@@ -69,6 +69,25 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 _IOV_MAX = 512
 
 
+def byte_views(buffers) -> list:
+    """Flat-byte memoryviews of ``buffers``, empties dropped — the shape
+    both send paths (blocking ``sendmsg_all``, the reactor's
+    ``sendmsg_some``) consume."""
+    return [v for v in (memoryview(b).cast("B") for b in buffers) if len(v)]
+
+
+def consume_sent(views: list, sent: int) -> None:
+    """Drop ``sent`` leading bytes from a list of byte views, in place —
+    the short-write bookkeeping shared by every scatter-gather sender."""
+    while sent:
+        if sent >= len(views[0]):
+            sent -= len(views[0])
+            views.pop(0)
+        else:
+            views[0] = views[0][sent:]
+            sent = 0
+
+
 def sendmsg_all(sock: socket.socket, buffers) -> None:
     """Scatter-gather send of a buffer list with NO intermediate join.
 
@@ -77,16 +96,25 @@ def sendmsg_all(sock: socket.socket, buffers) -> None:
     the kernel as an iovec via ``socket.sendmsg`` — the one copy on the
     send path is the kernel's.  Handles short writes and the IOV_MAX cap.
     """
-    views = [v for v in (memoryview(b).cast("B") for b in buffers) if len(v)]
+    views = byte_views(buffers)
     while views:
+        consume_sent(views, sock.sendmsg(views[:_IOV_MAX]))
+
+
+def sendmsg_some(sock: socket.socket, views: list) -> int:
+    """ONE scatter-gather send attempt on a non-blocking socket.
+
+    The serving reactor's write primitive: accepts whatever the kernel
+    buffer takes right now, consumes it from ``views`` in place, and
+    returns the byte count (0 when the buffer is full — the caller parks
+    the remainder and re-arms EVENT_WRITE).  Never blocks, never loops.
+    """
+    try:
         sent = sock.sendmsg(views[:_IOV_MAX])
-        while sent:
-            if sent >= len(views[0]):
-                sent -= len(views[0])
-                views.pop(0)
-            else:
-                views[0] = views[0][sent:]
-                sent = 0
+    except BlockingIOError:
+        return 0
+    consume_sent(views, sent)
+    return sent
 
 
 def backoff_delay(attempt: int, base: float, factor: float, max_delay: float,
@@ -149,6 +177,9 @@ def local_ip() -> str:
 
 
 _NONCE_BYTES = 32
+#: Size of the client's handshake response (nonce + digest) — what a
+#: non-blocking server accumulates before it can verify.
+HANDSHAKE_BLOB_BYTES = 2 * _NONCE_BYTES
 # Domain separation for the server's proof: without it a rogue server could
 # reflect the client's own digest back as "proof" of knowing the authkey.
 _SRV_PROOF_PREFIX = b"tos-coordinator-srv:"
@@ -161,6 +192,34 @@ def _digest(authkey: bytes, payload: bytes) -> bytes:
     return hmac.new(authkey, payload, hashlib.sha256).digest()
 
 
+def hmac_server_challenge() -> bytes:
+    """The server's opening handshake frame (its nonce) — sent first."""
+    import os
+
+    return os.urandom(_NONCE_BYTES)
+
+
+def hmac_server_verify(authkey: bytes, nonce_s: bytes,
+                       client_blob: bytes) -> tuple[bool, bytes]:
+    """Verify a client's ``HANDSHAKE_BLOB_BYTES`` response to ``nonce_s``.
+
+    Returns ``(ok, proof)`` where ``proof`` is the fixed-size frame to send
+    back regardless of outcome: the real server proof when the client
+    verified, random bytes (never a digest) otherwise, so the peer's
+    compare fails too.  This is the verification half of
+    ``hmac_handshake_server``, split out so a non-blocking server (the
+    serving reactor) can run the same handshake incrementally."""
+    import hmac
+    import os
+
+    nonce_c = bytes(client_blob[:_NONCE_BYTES])
+    got = bytes(client_blob[_NONCE_BYTES:])
+    ok = hmac.compare_digest(_digest(authkey, nonce_s), got)
+    proof = (_digest(authkey, _SRV_PROOF_PREFIX + nonce_c) if ok
+             else os.urandom(_NONCE_BYTES))
+    return ok, proof
+
+
 def hmac_handshake_server(sock: socket.socket, authkey: bytes) -> bool:
     """MUTUAL challenge-response on the shared cluster authkey;
     constant-time digest compares before any payload deserialization.
@@ -170,18 +229,11 @@ def hmac_handshake_server(sock: socket.socket, authkey: bytes) -> bool:
     queues relied on (``TFManager.py:~20-40``): the server verifies the
     client AND proves its own knowledge of the key, so a port-squatting
     impostor cannot impersonate the coordinator to a dialing node."""
-    import hmac
-    import os
-
-    nonce_s = os.urandom(_NONCE_BYTES)
+    nonce_s = hmac_server_challenge()
     sock.sendall(nonce_s)
-    buf = recv_exact(sock, 2 * _NONCE_BYTES)  # client nonce + client digest
-    nonce_c, got = buf[:_NONCE_BYTES], buf[_NONCE_BYTES:]
-    ok = hmac.compare_digest(_digest(authkey, nonce_s), got)
-    # Always answer with a fixed-size proof frame; a failed verify gets
-    # random bytes (never a digest), so the peer's compare fails too.
-    sock.sendall(_digest(authkey, _SRV_PROOF_PREFIX + nonce_c) if ok
-                 else os.urandom(_NONCE_BYTES))
+    buf = recv_exact(sock, HANDSHAKE_BLOB_BYTES)  # client nonce + digest
+    ok, proof = hmac_server_verify(authkey, nonce_s, buf)
+    sock.sendall(proof)
     return ok
 
 

@@ -278,6 +278,69 @@ def test_lock_locked_suffix_means_caller_holds_the_lock():
     assert len(found) == 1 and found[0].anchor == "C._wait_locked@block:sleep"
 
 
+def test_reactor_fires_on_blocking_calls_in_callback_scope():
+    found = lint(
+        """
+        import time
+        class ServeReactor:
+            def _on_readable(self, conn):
+                time.sleep(0.1)
+            def _sweep_deadlines(self):
+                blob = recv_exact(self._sock, 8)
+            def _flush_writes(self, conn):
+                sendmsg_all(conn.sock, conn.wviews)
+        """, f"{PKG}/serving/frontend.py", "reactor-discipline")
+    assert {f.anchor for f in found} == {
+        "ServeReactor._on_readable@block:sleep",
+        "ServeReactor._sweep_deadlines@block:recv_exact",
+        "ServeReactor._flush_writes@block:sendmsg_all"}
+
+
+def test_reactor_quiet_on_exempt_methods_safe_joins_and_other_scopes():
+    # __init__ (pre-publication) and stop() (caller-thread join point) are
+    # the two contract exemptions; str joins and the one-shot non-blocking
+    # primitives are not blocking; other files/classes are out of scope
+    assert lint(
+        """
+        class ServeReactor:
+            def __init__(self):
+                self._probe_thread.join()
+            def stop(self):
+                self._thread.join(timeout=10.0)
+            def _on_readable(self, conn):
+                name = ",".join(parts)
+                sent = sendmsg_some(conn.sock, conn.wviews)
+        """, f"{PKG}/serving/frontend.py", "reactor-discipline") == []
+    blocking_elsewhere = """
+        import time
+        class Helper:
+            def _on_readable(self):
+                time.sleep(0.1)
+        """
+    assert lint(blocking_elsewhere, f"{PKG}/serving/frontend.py",
+                "reactor-discipline") == []  # class is not a *Reactor*
+    assert lint(blocking_elsewhere.replace("Helper", "FooReactor"),
+                f"{PKG}/serving/router.py", "reactor-discipline") == []
+
+
+def test_dial_discipline_covers_the_reactor_frontend():
+    # the frontend does raw non-blocking socket I/O, but dials and the
+    # zero-copy loop primitives stay confined: a raw dial or sendmsg in
+    # serving/frontend.py fires like anywhere else
+    found = lint(
+        """
+        import socket
+        class ServeReactor:
+            def _reconnect(self, addr):
+                return socket.create_connection(addr)
+            def _flush(self, conn):
+                conn.sock.sendmsg(conn.wviews)
+        """, f"{PKG}/serving/frontend.py", "dial-discipline")
+    assert {f.anchor for f in found} == {
+        "ServeReactor._reconnect@create_connection",
+        "ServeReactor._flush@sendmsg"}
+
+
 def test_lock_fires_on_framing_wrapper_io_under_lock():
     # the tree's idiomatic blocking I/O goes through _send/_recv wrappers;
     # the checker must see those, not just bare socket method names

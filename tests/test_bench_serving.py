@@ -1,8 +1,9 @@
-"""Tier-1 smoke for the committed serving microbench (ISSUE 5 satellite):
-one tiny in-process config must run end-to-end and produce sane stats —
-the guard that keeps ``bench_serving.py`` importable and runnable as the
-serving path evolves (numbers in BENCH_r07.json / PERF_NOTES round 8 come
-from the full run on an idle box)."""
+"""Tier-1 smoke for the committed serving microbench (ISSUE 5 satellite,
+pipelined configs added by ISSUE 7): one tiny run of every config must go
+end-to-end and produce sane stats — the guard that keeps
+``bench_serving.py`` importable and runnable as the serving path evolves
+(numbers in BENCH_r07.json / BENCH_r09.json / PERF_NOTES come from full
+runs on an idle box)."""
 
 from __future__ import annotations
 
@@ -13,7 +14,8 @@ def test_bench_serving_quick_config_runs(monkeypatch):
 
     results = bench_serving.bench(quick=True)
     assert results["max_batch"] == 64 and results["num_nodes"] == 2
-    for label in ("1row", "1row_tcp", "64row_tcp"):
+    for label in ("1row", "1row_tcp", "1row_tcp_pipe", "1row_tcp_pool",
+                  "64row_tcp", "64row_tcp_pipe"):
         r = results["configs"][label]
         assert r["requests"] > 0
         assert r["qps"] > 0
@@ -21,6 +23,8 @@ def test_bench_serving_quick_config_runs(monkeypatch):
         assert r["rows_per_s"] >= r["qps"]
     assert results["configs"]["1row"]["transport"] == "inprocess"
     assert results["configs"]["64row_tcp"]["request_rows"] == 64
+    assert results["configs"]["1row_tcp_pipe"]["transport"] == "tcp pipe=8"
+    assert results["configs"]["1row_tcp_pool"]["transport"] == "tcp pool"
     # the table renderer stays in sync with the result schema
     table = bench_serving.markdown_table(results)
-    assert "1row_tcp" in table and "qps" in table
+    assert "1row_tcp_pipe" in table and "qps" in table
