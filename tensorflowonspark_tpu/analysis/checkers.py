@@ -24,7 +24,9 @@ plane makes likely (see ISSUE 2 / ROADMAP):
 - ``metrics-discipline``: metric stores are created through the telemetry
   registry, never as ad-hoc module-level dicts of counters — an ad-hoc
   store is invisible to ``cluster.metrics()``/the run report and ignores
-  the ``TOS_METRICS`` switch.
+  the ``TOS_METRICS`` switch.  Spans follow the same rule: recorded
+  through ``telemetry.trace`` with dotted-lowercase names, never buffered
+  in module-level span lists.
 - ``trace-purity``: no wall-clock reads, ``np.random``, ``os.environ`` or
   global/nonlocal mutation inside ``jax.jit``/``pjit``/``shard_map``-traced
   functions — tracing bakes the first value in forever.
@@ -569,6 +571,16 @@ _METRICISH_NAME = re.compile(
 _METRIC_CONTAINER_CALLS = frozenset({
     "dict", "defaultdict", "OrderedDict",
 })
+# Same idea for trace spans: a module-level list/deque of span records
+# bypasses telemetry.trace's per-thread rings — it never reaches the
+# heartbeat piggyback, the merged trace.json, or the TOS_TRACE switch.
+_SPANISH_NAME = re.compile(r"(?:^|_)(spans?|traces?)(?:_|$)", re.IGNORECASE)
+_SPAN_CONTAINER_CALLS = _METRIC_CONTAINER_CALLS | frozenset({"list", "deque"})
+# Span-name-bearing telemetry.trace entry points: their literal name must be
+# dotted lowercase (`layer.what`), matching the metric-name convention, so
+# merged traces group by subsystem instead of by whoever spelled it.
+_SPAN_RECORD_ATTRS = frozenset({"span", "record_span", "record_child"})
+_SPAN_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 
 @register_checker
@@ -583,11 +595,17 @@ class MetricsDisciplineChecker(Checker):
     hint = ("create the metric through tensorflowonspark_tpu.telemetry "
             "(counter()/gauge()/histogram()/timed()) so it reaches "
             "cluster.metrics(), the run report, and the TOS_METRICS switch")
+    span_hint = ("record spans through tensorflowonspark_tpu.telemetry."
+                 "trace (span()/record_span()/record_child()) with a "
+                 "dotted-lowercase name (e.g. 'serve.wire') so they reach "
+                 "the heartbeat piggyback, trace.json, and the TOS_TRACE "
+                 "switch")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        # the registry implementation itself is the one sanctioned home
+        # the registry/tracer implementations are the one sanctioned home
         if "/telemetry/" in mod.path:
             return
+        yield from self._check_span_calls(mod)
         for stmt in mod.tree.body:
             if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                 continue
@@ -605,6 +623,15 @@ class MetricsDisciplineChecker(Checker):
                     "ad-hoc metrics store outside the telemetry registry",
                     self.hint, f"<module>@{names[0]}")
                 continue
+            if (any(_SPANISH_NAME.search(n) for n in names)
+                    and self._is_span_container(mod, value)):
+                yield Finding(
+                    self.id, mod.path, stmt.lineno,
+                    f"module-level span buffer {names[0]!r} bypasses the "
+                    "telemetry tracer (invisible to the heartbeat "
+                    "piggyback, trace.json, and the TOS_TRACE switch)",
+                    self.span_hint, f"<module>@{names[0]}")
+                continue
             if not any(_METRICISH_NAME.search(n) for n in names):
                 continue
             if self._is_container_literal(mod, value):
@@ -614,6 +641,53 @@ class MetricsDisciplineChecker(Checker):
                     "the telemetry registry (invisible to cluster.metrics() "
                     "and the TOS_METRICS switch)",
                     self.hint, f"<module>@{names[0]}")
+
+    def _check_span_calls(self, mod: ModuleSource) -> Iterator[Finding]:
+        """Span names recorded through telemetry.trace must be dotted
+        lowercase (``layer.what``) — the metric-name convention, applied to
+        spans so merged traces group by subsystem."""
+        consts = _module_consts(mod.tree)
+        for node, scope in _scoped_walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPAN_RECORD_ATTRS):
+                continue
+            if not self._is_tracer_receiver(mod, node.func):
+                continue  # e.g. re.Match.span("group") is not our API
+            name = _literal_str(node.args[0] if node.args else None, consts)
+            if name is None or _SPAN_NAME_RE.match(name):
+                continue
+            yield Finding(
+                self.id, mod.path, node.lineno,
+                f"span name {name!r} is not dotted lowercase "
+                "(expected e.g. 'serve.wire')",
+                self.span_hint, f"{_qual(scope)}@span:{name}")
+
+    @staticmethod
+    def _is_tracer_receiver(mod: ModuleSource, func: ast.Attribute) -> bool:
+        """True when ``<recv>.span/record_*`` plausibly targets
+        telemetry.trace: the imported module (any alias), a Tracer-ish
+        local (``tracer``/``ttrace``/``trace``/``tr``), or a
+        ``get_tracer()`` call — not every object with a ``.span`` method
+        (``re.Match.span`` takes a group, not a span name)."""
+        fq = mod.imports.qualify(func)
+        if fq and (".telemetry.trace." in fq
+                   or fq.startswith("telemetry.trace.")):
+            return True
+        recv = func.value
+        if isinstance(recv, ast.Call):
+            return _terminal_name(recv.func) == "get_tracer"
+        return _terminal_name(recv) in ("trace", "ttrace", "tracer", "tr")
+
+    @staticmethod
+    def _is_span_container(mod: ModuleSource, value: ast.AST) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.DictComp, ast.ListComp)):
+            return True
+        if isinstance(value, ast.Call):
+            fq = mod.imports.qualify(value.func)
+            name = fq.rsplit(".", 1)[-1] if fq else _terminal_name(value.func)
+            return name in _SPAN_CONTAINER_CALLS
+        return False
 
     @staticmethod
     def _is_collections_counter(mod: ModuleSource, value: ast.AST) -> bool:

@@ -24,6 +24,8 @@ from __future__ import annotations
 import collections
 import contextlib
 import enum
+import glob
+import json
 import logging
 import os
 import secrets
@@ -33,6 +35,8 @@ from typing import Any, Callable, Sequence
 
 from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu.coordinator import CoordinatorServer
+from tensorflowonspark_tpu.telemetry import trace as ttrace
+from tensorflowonspark_tpu.telemetry import trace_export as ttrace_export
 from tensorflowonspark_tpu.data import as_partitioned
 from tensorflowonspark_tpu.dataserver import DataClient
 from tensorflowonspark_tpu.launcher import (  # noqa: F401 - LocalLauncher re-exported
@@ -737,6 +741,12 @@ class TPUCluster:
                 # is still in flight.
                 attempt = ledger.attempts(task)
                 epoch, p = task
+                # sampled partitions get a trace: root span = ledger
+                # assignment -> buffered ack, the feed itself a child, and
+                # the ctx rides the EndPartition so the node's consume span
+                # (feed -> map_fun) joins the same trace
+                part_trace = ttrace.sample()
+                t_assign = time.monotonic()
                 try:
                     if client is None:
                         client = self._client(executor_id)
@@ -748,10 +758,13 @@ class TPUCluster:
                     # span: wall time to stream + ack one partition (send
                     # rate AND node-side backpressure both land in here —
                     # the first place to look when train() slows down)
-                    with telemetry.timed("driver.feed_partition_secs"):
+                    with telemetry.timed("driver.feed_partition_secs"), \
+                            ttrace.span("driver.feed_partition",
+                                        parent=part_trace):
                         state = client.feed_partition(
                             views[epoch].iter_partition(p), qname,
-                            task_key=(train_gen,) + task)
+                            task_key=(train_gen,) + task,
+                            trace=part_trace)
                 except Exception as e:  # noqa: BLE001 - wrapped + ledgered below
                     wrapped = RuntimeError(
                         f"feeding executor {executor_id} failed on partition "
@@ -791,6 +804,11 @@ class TPUCluster:
                     ledger.abandon_slot(worker_pos)
                     return
                 ledger.ack(worker_pos, client.partitions_consumed(qname))
+                ttrace.record_span(
+                    "train.partition", part_trace, None, t_assign,
+                    time.monotonic() - t_assign,
+                    {"epoch": epoch, "partition": p, "executor": executor_id,
+                     "attempt": attempt} if part_trace else None)
 
         def _runner(worker_pos: int, executor_id: int) -> None:
             try:
@@ -1163,9 +1181,26 @@ class TPUCluster:
             # node has deregistered (or died) by now, so the coordinator's
             # per-node store holds the final snapshots.
             self._stop_metrics_export()
+            # stream assembly copies every bounded span store and parses
+            # every flight dump: gather once, feed both writers
+            trace_streams: dict[str, dict] | None = None
+            try:
+                trace_streams = self._trace_streams_with_dumps()
+            except Exception:  # noqa: BLE001 - tracing must not mask errors
+                logger.warning("could not gather trace streams",
+                               exc_info=True)
+            try:
+                trace_path = self.write_trace_artifacts(trace_streams)
+                if trace_path:
+                    logger.info("merged trace written to %s (load it at "
+                                "https://ui.perfetto.dev)", trace_path)
+            except Exception:  # noqa: BLE001 - tracing must not mask errors
+                logger.warning("could not write trace artifacts",
+                               exc_info=True)
             try:
                 if telemetry.enabled() and _env_bool("TOS_RUN_REPORT", True):
-                    report_path = self.write_run_report()
+                    report_path = self.write_run_report(
+                        streams=trace_streams)
                     if report_path:
                         logger.info("run report written to %s", report_path)
             except Exception:  # noqa: BLE001 - reporting must not mask errors
@@ -1215,12 +1250,77 @@ class TPUCluster:
         """
         return self.coordinator.cluster_metrics()
 
+    def stats(self, window: float = 10.0) -> dict:
+        """Rolling-window LIVE stats — the autoscaling signals, not
+        run-lifetime aggregates: qps, request p50/p99, serve-queue depth
+        and in-flight batches (driver stream), plus per-node counter rates
+        and feed-queue occupancy, all computed over the last ``window``
+        seconds only.  The same payload is remotely queryable through the
+        coordinator's ``statz`` op (``CoordinatorClient.stats``).  Headline
+        fields live under ``"serving"``; per-stream detail under
+        ``"streams"``."""
+        return self.coordinator.cluster_stats(window)
+
+    def _trace_streams_with_dumps(self) -> dict[str, dict]:
+        """Every process's trace stream (heartbeat-shipped spans/events +
+        clock offsets) keyed for export, plus any on-disk flight dumps a
+        chaos kill left in ``log_dir`` (SIGKILL forecloses the heartbeat
+        path — the dump file is the dead node's only record)."""
+        streams: dict[str, dict] = {}
+        for key, stream in self.coordinator.trace_streams().items():
+            streams[key if key == "driver" else f"node{key}"] = stream
+        if self.log_dir:
+            for path in sorted(glob.glob(
+                    os.path.join(self.log_dir, "flight_*.json"))):
+                key = os.path.basename(path)[len("flight_"):-len(".json")]
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        streams[f"flight:{key}"] = json.load(f)
+                except Exception:  # noqa: BLE001 - a torn dump must not mask the run
+                    logger.debug("unreadable flight dump %s", path,
+                                 exc_info=True)
+        return streams
+
+    def write_trace_artifacts(
+            self, streams: dict[str, dict] | None = None) -> str | None:
+        """Write the run's trace artifacts into ``log_dir``: one
+        ``trace_<key>.json`` stream per process plus the merged,
+        Perfetto-loadable ``trace.json``.  Returns the merged path, or
+        None when tracing is off (``TOS_TRACE=0`` leaves zero artifacts)
+        or there is no ``log_dir``.  Called automatically at shutdown;
+        the standalone merge CLI is
+        ``python -m tensorflowonspark_tpu.telemetry.trace_export``."""
+        if not self.log_dir:
+            return None
+        if streams is None:
+            streams = self._trace_streams_with_dumps()
+        # Tracing may be armed in the node processes only
+        # (cluster.run(env={"TOS_TRACE": "1"})): node-shipped spans count
+        # even when the driver's own tracer is off.  Flight events alone
+        # don't (they're recorded regardless of TOS_TRACE): an untraced
+        # chaos run keeps its timeline in run_report.json, and TOS_TRACE=0
+        # everywhere still leaves zero trace artifacts.
+        if not (ttrace.enabled()
+                or any(s.get("spans") for s in streams.values())):
+            return None
+        if not any(s.get("spans") or s.get("events")
+                   for s in streams.values()):
+            return None
+        for key, stream in streams.items():
+            if key.startswith("flight:"):
+                continue  # the chaos dump is already its own file
+            ttrace_export.write_stream(
+                os.path.join(self.log_dir, f"trace_{key}.json"), stream)
+        return ttrace_export.write_merged(
+            os.path.join(self.log_dir, "trace.json"), streams)
+
     def debug_dump(self) -> str:
         """Human-readable text report of ``metrics()`` (paste into a bug
         report; the run report is the JSON twin)."""
         return telemetry.debug_dump(self.metrics())
 
-    def write_run_report(self, path: str | None = None) -> str | None:
+    def write_run_report(self, path: str | None = None,
+                         streams: dict[str, dict] | None = None) -> str | None:
         """Write the end-of-run JSON run report; returns the path (None when
         there is nowhere to write: no ``path`` and no ``log_dir``).
 
@@ -1232,18 +1332,30 @@ class TPUCluster:
             if not self.log_dir:
                 return None
             path = os.path.join(self.log_dir, "run_report.json")
+        extras: dict = {
+            "num_executors": len(self.cluster_info),
+            "node_errors": len(self.coordinator.errors()),
+            "restarts_by_executor": (
+                {str(eid): self.supervisor.restart_count(eid)
+                 for eid in self._feed_ids
+                 if self.supervisor.restart_count(eid)}
+                if self.supervisor is not None else {}),
+        }
+        try:
+            # flight-recorder timeline: every process's structured events
+            # (kills, deaths, retries, resyncs, reloads) merged onto the
+            # driver clock — the postmortem a chaos exit is read by
+            flight = ttrace.merge_events(
+                self._trace_streams_with_dumps()
+                if streams is None else streams)
+            if flight:
+                extras["flight"] = {"events": flight}
+        except Exception:  # noqa: BLE001 - reporting must not mask the run error
+            logger.debug("could not merge flight events", exc_info=True)
         report = telemetry.build_run_report(
             self.metrics(),
             wall_secs=round(time.monotonic() - self._started_at, 3),
-            extras={
-                "num_executors": len(self.cluster_info),
-                "node_errors": len(self.coordinator.errors()),
-                "restarts_by_executor": (
-                    {str(eid): self.supervisor.restart_count(eid)
-                     for eid in self._feed_ids
-                     if self.supervisor.restart_count(eid)}
-                    if self.supervisor is not None else {}),
-            })
+            extras=extras)
         return telemetry.write_run_report(path, report)
 
     def _metrics_export_loop(self) -> None:

@@ -34,6 +34,8 @@ import time
 from typing import Any
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
+from tensorflowonspark_tpu.telemetry.registry import percentile_of
 
 logger = logging.getLogger(__name__)
 
@@ -41,6 +43,14 @@ logger = logging.getLogger(__name__)
 # (each heartbeat delta ships up to telemetry.OUTBOX_SIZE new samples per
 # histogram; the store keeps a bounded tail per (node, metric)).
 _HIST_RECENT_CAP = 256
+# Per-node trace-stream store bounds: spans a run keeps for the merged
+# trace.json, flight events for the run report's timeline.
+_TRACE_SPAN_CAP = 16384
+_TRACE_EVENT_CAP = 1024
+# Rolling-stats history: one entry per heartbeat merge (nodes) / sampler
+# tick (driver); 240 entries at ~1-2s cadence cover several minutes of
+# window, far past any sensible `cluster.stats(window=...)`.
+_STATS_HISTORY_CAP = 240
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -81,6 +91,59 @@ class _Rendezvous:
         self.t0 = time.monotonic()
 
 
+def _window_stats(entries: list, now: float, window: float) -> dict | None:
+    """Rolling-window view of one stream's history entries
+    ``(t, cumulative_counters, gauges, hist_samples)``: counter rates over
+    the window, percentiles pooled from in-window samples only, latest
+    gauges.  None when the stream has no history at all."""
+    if not entries:
+        return None
+    start = now - window
+    last_t, last_counters, last_gauges, _ = entries[-1]
+    # baseline: the newest entry at/before the window start (so the delta
+    # spans the whole window); with a short history, the earliest entry
+    base = entries[0]
+    for e in entries:
+        if e[0] <= start:
+            base = e
+        else:
+            break
+    rates: dict[str, float] = {}
+    if last_t <= start:
+        # nothing moved inside the window: every rate is flat zero (a stale
+        # delta must not report phantom load after traffic stops)
+        rates = {name: 0.0 for name in last_counters}
+    else:
+        dt = last_t - base[0]
+        if dt > 0:
+            for name, v in last_counters.items():
+                # clamp: a counter reset inside the window (process restart
+                # the history clear raced) must read as idle, never negative
+                delta = max(0, v - base[1].get(name, 0))
+                if delta:
+                    rates[name] = round(delta / dt, 3)
+                else:
+                    rates[name] = 0.0
+    pool: dict[str, list[float]] = {}
+    for t, _c, _g, samples in entries:
+        if t < start:
+            continue
+        for name, vals in samples.items():
+            pool.setdefault(name, []).extend(vals)
+    percentiles = {
+        name: {"n": len(vals),
+               "p50": percentile_of(vals, 50.0),
+               "p99": percentile_of(vals, 99.0)}
+        for name, vals in ((n, sorted(v)) for n, v in pool.items()) if vals}
+    return {"age_secs": round(now - last_t, 3), "rates": rates,
+            "gauges": dict(last_gauges), "percentiles": percentiles}
+
+
+def _pct_ms(stream: dict, name: str, q: str) -> float | None:
+    v = ((stream.get("percentiles") or {}).get(name) or {}).get(q)
+    return round(v * 1e3, 3) if v is not None else None
+
+
 def _reduce(kind: str, values: list[Any]) -> Any:
     if kind == "any":
         return any(values)
@@ -105,7 +168,7 @@ class CoordinatorServer:
     """
 
     def __init__(self, expected: int, roles: list[tuple[str, int]] | None = None,
-                 authkey: bytes | None = None):
+                 authkey: bytes | None = None, stats_interval: float = 1.0):
         if roles is not None and len(roles) != expected:
             raise ValueError("roles must have one entry per expected node")
         self.expected = expected
@@ -139,6 +202,20 @@ class CoordinatorServer:
         # counters restart with its process (per-incarnation counters).
         self._node_metrics: dict[int, dict] = {}
         self._hist_recent: dict[int, dict[str, list[float]]] = {}
+        # Trace-stream store: spans/flight events each node piggybacks on
+        # heartbeats (and the final deregister), plus its latest clock
+        # offset estimate, keyed by executor id; "driver" entries accumulate
+        # from this process's own tracer on demand (bounded like the rest).
+        self._node_trace: dict[str, dict] = {}
+        # Rolling-stats history (cluster.stats): per node one timestamped
+        # entry per heartbeat merge; the "driver" stream is appended by a
+        # sampler thread started with the server (the driver sends no
+        # heartbeats, and its registry holds the serving-gateway signals
+        # the autoscaler wants).
+        self._stats_history: dict[str, list] = {}
+        self._stats_interval = max(0.05, float(stats_interval))
+        self._stats_stop = threading.Event()
+        self._stats_thread: threading.Thread | None = None
         # DIRECT-mode job manifest: what the driver's shard enumeration
         # produced for the current train() (shard/partition/epoch counts),
         # published so map_funs can read progress denominators without a
@@ -222,11 +299,22 @@ class CoordinatorServer:
         self.address = (advertise, port)
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="coordinator")
         self._thread.start()
+        # driver stats sampler: the rolling-window half of cluster.stats()
+        # for THIS process's registry (nodes sample themselves implicitly,
+        # one history entry per heartbeat merge)
+        self._stats_thread = threading.Thread(target=self._stats_loop,
+                                              daemon=True,
+                                              name="coordinator-stats")
+        self._stats_thread.start()
         logger.info("coordinator listening on %s:%d (expecting %d nodes)", *self.address, self.expected)
         return self.address
 
     def stop(self) -> None:
         self._stop_flag.set()
+        self._stats_stop.set()
+        if self._stats_thread is not None:
+            self._stats_thread.join(timeout=5.0)
+            self._stats_thread = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -292,6 +380,10 @@ class CoordinatorServer:
                     continue
                 newly.append(i)
                 self._incarnations[i] = self._incarnations.get(i, 0) + 1
+                # a restarted slot's counters restart at 0: its rolling-stats
+                # stream must restart with them, or the first post-restart
+                # window computes negative rates against the old cumulatives
+                self._stats_history.pop(str(i), None)
                 if record_error:
                     self._errors.append({
                         "executor_id": i,
@@ -303,6 +395,8 @@ class CoordinatorServer:
         if newly:
             telemetry.counter("coordinator.deaths_total").inc(len(newly))
             telemetry.gauge("coordinator.live_slots").set(live)
+            for eid in newly:
+                ttrace.event("death", executor=eid)
             self._abort_rendezvous()
         return newly
 
@@ -341,6 +435,7 @@ class CoordinatorServer:
             executor_id, {"counters": {}, "gauges": {}, "histograms": {}})
         store["counters"].update(payload.get("counters") or {})
         store["gauges"].update(payload.get("gauges") or {})
+        window_samples: dict[str, list[float]] = {}
         for name, d in (payload.get("histograms") or {}).items():
             store["histograms"][name] = {
                 k: d.get(k) for k in ("count", "sum", "min", "max")}
@@ -350,6 +445,132 @@ class CoordinatorServer:
                     executor_id, {}).setdefault(name, [])
                 pool.extend(float(v) for v in recent)
                 del pool[:-_HIST_RECENT_CAP]
+                window_samples[name] = [float(v) for v in recent]
+        # rolling-stats history: the heartbeat cadence IS the node's sample
+        # clock — one timestamped cumulative snapshot per merge
+        self._append_stats_locked(str(executor_id),
+                                  dict(store["counters"]),
+                                  dict(store["gauges"]), window_samples)
+
+    # -- trace streams (span/flight-event transport) --------------------------
+
+    def _merge_trace_locked(self, key: str, payload: dict) -> None:
+        """Fold one process's heartbeat trace delta (spans + flight events +
+        clock offset) into its bounded stream store."""
+        store = self._node_trace.setdefault(
+            key, {"spans": [], "events": [], "offset": None, "rtt": None,
+                  "dropped": 0})
+        spans = payload.get("spans")
+        if spans:
+            store["spans"].extend(spans)
+            del store["spans"][:-_TRACE_SPAN_CAP]
+        events = payload.get("events")
+        if events:
+            store["events"].extend(events)
+            del store["events"][:-_TRACE_EVENT_CAP]
+        if payload.get("offset") is not None:
+            store["offset"] = float(payload["offset"])
+            store["rtt"] = payload.get("rtt")
+        if payload.get("dropped"):
+            store["dropped"] = int(payload["dropped"])
+
+    def _drain_driver_trace(self) -> None:
+        """Accumulate this process's own tracer into the store under
+        ``"driver"`` (the driver sends no heartbeats; offset is 0 by
+        definition — its clock IS the merge timeline)."""
+        delta = ttrace.collect_final()  # uncapped: no next beat ships the rest
+        if delta is not None:
+            delta["offset"] = 0.0
+            with self._lock:
+                self._merge_trace_locked("driver", delta)
+
+    def clear_trace_streams(self) -> None:
+        """Drop every accumulated trace stream (driver included) — phase
+        isolation for benches that run several differently-shaped loads on
+        one cluster and must not pool spans across them."""
+        ttrace.collect_final()  # discard the driver tracer's whole backlog
+        with self._lock:
+            self._node_trace.clear()
+
+    def trace_streams(self) -> dict[str, dict]:
+        """Every process's trace stream, export-ready: ``{key: {"spans",
+        "events", "clock_offset", ...}}`` (``trace_export.build_stream``
+        shape).  Driver spans are drained into the store first."""
+        self._drain_driver_trace()
+        with self._lock:
+            out: dict[str, dict] = {}
+            for key, store in self._node_trace.items():
+                out[key] = {"schema": "tos-trace-stream-v1", "node": key,
+                            "clock_offset": store["offset"],
+                            "spans": list(store["spans"]),
+                            "events": list(store["events"]),
+                            "dropped": store["dropped"]}
+            return out
+
+    # -- rolling-window stats (cluster.stats / the `statz` op) ----------------
+
+    def _append_stats_locked(self, key: str, counters: dict, gauges: dict,
+                             samples: dict[str, list[float]]) -> None:
+        hist = self._stats_history.setdefault(key, [])
+        hist.append((time.monotonic(), counters, gauges, samples))
+        del hist[:-_STATS_HISTORY_CAP]
+
+    def _stats_loop(self) -> None:
+        while not self._stats_stop.wait(self._stats_interval):
+            try:
+                self._sample_driver_stats()
+            except Exception:  # noqa: BLE001 - observability must not kill jobs
+                logger.debug("driver stats sample failed", exc_info=True)
+
+    def _sample_driver_stats(self) -> None:
+        """One driver history entry: cumulative counters + gauges + the
+        histogram samples observed since the last tick (outbox drain — the
+        driver's outboxes have no heartbeat consumer, so this is their one
+        reader)."""
+        if not telemetry.enabled():
+            return
+        reg = telemetry.get_registry()
+        snap = reg.snapshot()
+        samples = reg.drain_recent()
+        with self._lock:
+            self._append_stats_locked("driver", snap.get("counters") or {},
+                                      snap.get("gauges") or {}, samples)
+
+    def cluster_stats(self, window: float = 10.0) -> dict:
+        """Rolling-window live stats — the signals replica autoscaling will
+        consume, NOT run-lifetime aggregates: per-key windowed counter
+        rates (qps and friends), windowed histogram percentiles (p50/p99
+        over the last ``window`` seconds' samples only), and latest gauges
+        (serve-queue depth, feed-queue occupancy).  ``"driver"`` carries
+        the gateway-side view; node keys carry each node's own."""
+        self._sample_driver_stats()  # stats() must be current, not ticked
+        window = max(0.1, float(window))
+        now = time.monotonic()
+        with self._lock:
+            history = {k: list(v) for k, v in self._stats_history.items()}
+        out: dict = {"schema": "tos-statz-v1", "window_secs": window,
+                     "streams": {}}
+        for key, entries in history.items():
+            stream = _window_stats(entries, now, window)
+            if stream is not None:
+                out["streams"][key] = stream
+        driver = out["streams"].get("driver") or {}
+        # headline: the exact autoscaler inputs, pre-plucked
+        out["serving"] = {
+            "qps": (driver.get("rates") or {}).get("serve.requests_total"),
+            "p50_ms": _pct_ms(driver, "serve.request_secs", "p50"),
+            "p99_ms": _pct_ms(driver, "serve.request_secs", "p99"),
+            "queue_depth": (driver.get("gauges") or {}).get(
+                "serve.queue_depth"),
+            "inflight_batches": (driver.get("gauges") or {}).get(
+                "serve.inflight_batches"),
+            "replicas_healthy": (driver.get("gauges") or {}).get(
+                "serve.replicas_healthy"),
+            "feed_queue_depth": {
+                key: (s.get("gauges") or {}).get("feed.queue_depth")
+                for key, s in out["streams"].items() if key != "driver"},
+        }
+        return out
 
     def cluster_metrics(self) -> dict:
         """Aggregated cluster snapshot (the ``metrics`` op / the
@@ -454,9 +675,24 @@ class CoordinatorServer:
                         if msg.get("metrics"):
                             self._merge_metrics_locked(int(msg["executor_id"]),
                                                        msg["metrics"])
-                return {"ok": True, "stop": self._stop_flag.is_set()}
+                    # trace deltas are append-only (spans/events, never a
+                    # snapshot overwrite), so keep one even from a ping that
+                    # raced deregister — it holds spans the final delta
+                    # doesn't, and the node-side restore path never sees a
+                    # reply that said ok.  Zombies never reach here (fenced).
+                    if msg.get("trace"):
+                        self._merge_trace_locked(str(msg["executor_id"]),
+                                                 msg["trace"])
+                # "now" is this process's monotonic clock at reply build —
+                # the client's RTT-midpoint clock-offset estimate hangs off
+                # it (trace timeline merging, trace_export.py)
+                return {"ok": True, "stop": self._stop_flag.is_set(),
+                        "now": time.monotonic()}
             if op == "metrics":
                 return {"ok": True, "snapshot": self.cluster_metrics()}
+            if op == "statz":
+                return {"ok": True, "stats": self.cluster_stats(
+                    float(msg.get("window") or 10.0))}
             if op == "manifest":
                 with self._lock:
                     return {"ok": True, "manifest": dict(self._manifest)}
@@ -471,6 +707,9 @@ class CoordinatorServer:
                     if msg.get("metrics"):
                         self._merge_metrics_locked(int(msg["executor_id"]),
                                                    msg["metrics"])
+                    if msg.get("trace"):
+                        self._merge_trace_locked(str(msg["executor_id"]),
+                                                 msg["trace"])
                 return {"ok": True}
             if op == "error":
                 with self._lock:
@@ -627,6 +866,11 @@ class CoordinatorClient:
         self._gen = 0
         self._executor_id: int | None = None
         self._incarnation = 0
+        # latest clock estimate from a heartbeat round-trip (driver-mono =
+        # local-mono + offset, midpoint method); the node's heartbeat loop
+        # feeds the best of these to the tracer for timeline merging
+        self.last_clock_offset: float | None = None
+        self.last_rtt: float | None = None
 
     def set_identity(self, executor_id: int, incarnation: int = 0) -> None:
         """Adopt the registration-assigned identity: every subsequent message
@@ -724,19 +968,39 @@ class CoordinatorClient:
         """Patch this node's registered metadata (e.g. tensorboard URL)."""
         self._check(self._call({"op": "update_meta", "executor_id": executor_id, "patch": patch}))
 
-    def heartbeat(self, executor_id: int, metrics: dict | None = None) -> bool:
+    def heartbeat(self, executor_id: int, metrics: dict | None = None,
+                  trace: dict | None = None) -> bool:
         """Send liveness ping; returns True if the driver asked us to stop.
         ``metrics`` piggybacks a compact telemetry delta
-        (``telemetry.collect_changed``) on the ping — the cluster metrics
-        transport costs no extra round-trips."""
+        (``telemetry.collect_changed``) and ``trace`` a span/flight-event
+        delta (``telemetry.trace.collect_delta``) on the ping — the cluster
+        observability transport costs no extra round-trips.  Each ping also
+        refreshes ``last_clock_offset``/``last_rtt`` from the reply's
+        server clock (NTP-style midpoint), the estimate trace export uses
+        to merge per-node span streams onto the driver timeline."""
         msg: dict = {"op": "heartbeat", "executor_id": executor_id}
         if metrics:
             msg["metrics"] = metrics
-        return bool(self._check(self._call(msg))["stop"])
+        if trace:
+            msg["trace"] = trace
+        t0 = time.monotonic()
+        resp = self._check(self._call(msg))
+        t1 = time.monotonic()
+        server_now = resp.get("now")
+        if server_now is not None:
+            self.last_rtt = t1 - t0
+            self.last_clock_offset = float(server_now) - (t0 + t1) / 2.0
+        return bool(resp["stop"])
 
     def metrics(self) -> dict:
         """Aggregated cluster metrics snapshot (the ``metrics`` op)."""
         return self._check(self._call({"op": "metrics"}))["snapshot"]
+
+    def stats(self, window: float = 10.0) -> dict:
+        """Rolling-window cluster stats (the ``statz`` op): live qps /
+        p50/p99 / queue depths over the last ``window`` seconds."""
+        return self._check(self._call({"op": "statz",
+                                       "window": float(window)}))["stats"]
 
     def manifest(self) -> dict:
         """The driver-published DIRECT-mode job manifest (empty dict until
@@ -746,12 +1010,16 @@ class CoordinatorClient:
     def report_error(self, executor_id: int, traceback_str: str) -> None:
         self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})
 
-    def deregister(self, executor_id: int, metrics: dict | None = None) -> None:
+    def deregister(self, executor_id: int, metrics: dict | None = None,
+                   trace: dict | None = None) -> None:
         """Announce a deliberate exit (stops dead-node tracking for this id);
-        ``metrics`` carries the node's final telemetry snapshot."""
+        ``metrics`` carries the node's final telemetry snapshot and
+        ``trace`` its final span/flight-event delta."""
         msg: dict = {"op": "deregister", "executor_id": executor_id}
         if metrics:
             msg["metrics"] = metrics
+        if trace:
+            msg["trace"] = trace
         self._call(msg)
 
     def request_stop(self) -> None:

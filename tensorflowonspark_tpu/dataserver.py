@@ -49,6 +49,7 @@ from time import monotonic as _monotonic
 from typing import Any, Iterable
 
 from tensorflowonspark_tpu import faultinject, telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.data import _MIN_OOB_ROW_BYTES as _MIN_OOB_BYTES
 from tensorflowonspark_tpu.data import pack_chunk as _pack_chunk
 from tensorflowonspark_tpu.data import unpack_items as _unpack_items
@@ -64,8 +65,11 @@ _VEC_BIT = 1 << 63
 # giant header allocation before the pickle layer ever sees it
 _MAX_SECTIONS = 1 << 20
 #: Highest wire version this build speaks; negotiated down via the ``hello``
-#: op (old servers answer it with an unknown-op error -> v1).
-WIRE_VERSION = 2
+#: op (old servers answer it with an unknown-op error -> v1).  v3 frames are
+#: byte-identical to v2 (protocol-5 vectorized); the bump only gates the op
+#: schema extension that appends a trace context to ``infer_round``/
+#: ``end_partition`` — a v2 peer never sees the extra element.
+WIRE_VERSION = 3
 # shm-ring v2 records carry an explicit magic (ring records are pickled blobs
 # otherwise, which always start with b"\x80")
 _RING_VEC_MAGIC = b"TOSVEC2\x00"
@@ -390,7 +394,9 @@ class DataServer:
             consumed = self.queues.partitions_consumed(msg[1])
             state = self._put_responsive(
                 self.queues.get_queue(msg[1]),
-                EndPartition(msg[2] if len(msg) > 2 else None))
+                EndPartition(msg[2] if len(msg) > 2 else None,
+                             trace=ttrace.coerce_context(
+                                 msg[3] if len(msg) > 3 else None)))
             if state is not None and state[0] == "err":
                 return ("err", f"feed timeout placing EndPartition after {self.feed_timeout}s")
             # reply carries the consumption watermark: how many partitions the
@@ -454,14 +460,21 @@ class DataServer:
             # The send/collect split (infer_send + collect polling) exists so
             # BIG partitions never pin a connection; a serving batch is tiny
             # and latency-bound, so here the round-trip count wins instead.
-            _, qname_in, qname_out, items, wait = msg
+            # A v3 peer may append the sampled batch's trace context: this
+            # round records the node-side serve.node_round span under it
+            # (queue put -> results popped), and the EndPartition carries it
+            # to the consumer for the compute span.
+            _, qname_in, qname_out, items, wait = msg[:5]
+            round_trace = ttrace.coerce_context(msg[5] if len(msg) > 5
+                                                else None)
+            round_t0 = _monotonic()
             items = _unpack_items(items)
             telemetry.counter("dataplane.chunks_in").inc()
             telemetry.counter("dataplane.rows_in").inc(len(items))
             if self.queues.get("state") == "terminating":
                 return ("ok", None, "terminating")
             q = self.queues.get_queue(qname_in)
-            for item in (*items, EndPartition()):
+            for item in (*items, EndPartition(trace=round_trace)):
                 state = self._put_responsive(q, item)
                 if state is not None:
                     return (state if state[0] == "err"
@@ -482,6 +495,9 @@ class DataServer:
                                            timeout=min(0.5, remaining)))
                 except queue.Empty:  # toslint: allow-silent(bounded poll slice; the while loop re-checks state and deadline)
                     pass
+            ttrace.record_child("serve.node_round", round_trace, round_t0,
+                                _monotonic() - round_t0,
+                                {"rows": len(items)})
             return ("ok", results, "running")
         if op == "collect":
             # Pop up to max_n inference results: block briefly for the first,
@@ -809,12 +825,14 @@ class DataClient:
         return chunk
 
     def feed_partition(self, items: Iterable[Any], qname: str = "input",
-                       task_key: Any = None) -> str:
+                       task_key: Any = None, trace: Any = None) -> str:
         """Stream one partition; returns final node state
         ('running'/'terminating').  ``task_key`` identifies the logical
         partition (the driver ledger's (epoch, partition)) so the node's
         consumption watermark counts an at-least-once re-feed of the same
-        partition exactly once (see ``marker.EndPartition``).
+        partition exactly once (see ``marker.EndPartition``).  ``trace``
+        (a sampled partition's trace context) rides the EndPartition on a
+        v3 wire so the node's partition-consume span joins the trace.
 
         Chunks are PIPELINED: up to ``send_window`` chunk frames ride the
         transport before their acks are read, so the sender never idles a
@@ -824,7 +842,10 @@ class DataClient:
         recovery, exactly as it does for the unpipelined path.
         """
         state = self._stream_chunks(items, qname)
-        reply = self._call(("end_partition", qname, task_key))
+        msg = (("end_partition", qname, task_key, tuple(trace))
+               if trace is not None and self._wire >= 3
+               else ("end_partition", qname, task_key))
+        reply = self._call(msg)
         if len(reply) > 1:
             # node's consumption watermark as of this partition's EndPartition
             # placement (see DataServer end_partition)
@@ -966,20 +987,26 @@ class DataClient:
 
     def infer_round(self, items: Iterable[Any], qname_in: str = "input",
                     qname_out: str = "output",
-                    wait: float | None = None) -> list:
+                    wait: float | None = None, trace: Any = None) -> list:
         """Score one micro-batch in a SINGLE round-trip (serving hot path):
         the server feeds the items, waits for the map_fun's results, and
         the reply carries them — no separate collect polling.  Returns
         exactly-count ordered results; raises when the node is terminating
-        or the round times out.  Requires a server with the ``infer_round``
-        op (this build); the chunked send/collect pair remains the right
-        tool for big batch partitions."""
+        or the round times out.  ``trace`` (the sampled batch's context)
+        is appended on a v3 wire so the node records its side of the round.
+        Requires a server with the ``infer_round`` op (this build); the
+        chunked send/collect pair remains the right tool for big batch
+        partitions."""
         items = list(items)
         wait = self.stall_timeout if wait is None else wait
         # no sender_gate permit: the round spans node COMPUTE, and the gate
         # contract forbids holding a send permit across anything but a send
-        reply = self._call(("infer_round", qname_in, qname_out,
-                            self._pack_items(items), wait))
+        msg = (("infer_round", qname_in, qname_out,
+                self._pack_items(items), wait, tuple(trace))
+               if trace is not None and self._wire >= 3
+               else ("infer_round", qname_in, qname_out,
+                     self._pack_items(items), wait))
+        reply = self._call(msg)
         if len(reply) > 2 and reply[2] == "terminating":
             raise RuntimeError(
                 "data plane error: node terminated mid-inference round")

@@ -136,14 +136,32 @@ class FaultPlan:
         """Meter the fired fault (telemetry): chaos tests assert recovery
         counters against these, and a soak run's report shows how many
         faults it actually exercised.  A ``kill`` SIGKILLs before the next
-        heartbeat can ship the count — that loss is the fault's own point."""
+        heartbeat can ship the count — that loss is the fault's own point
+        (which is exactly why the flight recorder dumps to DISK before a
+        kill: see ``batch_consumed``)."""
         from tensorflowonspark_tpu import telemetry
+        from tensorflowonspark_tpu.telemetry import trace as ttrace
 
         telemetry.counter("faultinject.injected_total").inc()
         telemetry.counter(f"faultinject.injected.{name}").inc()
+        ttrace.event("fault", action=name, pid=os.getpid())
 
 
 _PLAN: FaultPlan | None = None
+# Flight-recorder postmortem path (node_main sets it from the cluster's
+# log_dir): a `kill` dumps the process's recent spans + events here in the
+# instant before SIGKILL — the ONE artifact a kill cannot destroy, since
+# SIGKILL forecloses every in-memory channel (heartbeats, deregister).
+_FLIGHT_DUMP_PATH: str | None = None
+_FLIGHT_DUMP_NODE: str = ""
+
+
+def set_flight_dump(path: str | None, node: str = "") -> None:
+    """Where (and as whom) this process should dump its flight recorder if
+    a ``kill`` fault fires."""
+    global _FLIGHT_DUMP_PATH, _FLIGHT_DUMP_NODE
+    _FLIGHT_DUMP_PATH = path
+    _FLIGHT_DUMP_NODE = node
 
 
 def init_from_env(force: bool = False) -> None:
@@ -169,9 +187,19 @@ def set_identity(executor_id: int, incarnation: int = 0) -> None:
 def batch_consumed() -> None:
     """Hook: one feed batch fully consumed by the map_fun.  ``kill`` fires
     here with SIGKILL — the most brutal death available: no atexit, no
-    deregister, no flush, exactly what a preempted VM looks like."""
+    deregister, no flush, exactly what a preempted VM looks like.  The one
+    concession: the flight recorder dumps to disk first (a real preemption
+    grants no such grace, but the dump is the postmortem artifact the chaos
+    tests and operators read — and it costs microseconds)."""
     if _PLAN is not None and _PLAN._tick("kill"):
         logger.warning("fault injection: SIGKILL self (pid %d)", os.getpid())
+        if _FLIGHT_DUMP_PATH:
+            try:
+                from tensorflowonspark_tpu.telemetry import trace as ttrace
+
+                ttrace.dump_flight(_FLIGHT_DUMP_PATH, node=_FLIGHT_DUMP_NODE)
+            except Exception:  # noqa: BLE001 - the kill must still fire
+                logger.warning("flight dump before kill failed", exc_info=True)
         os.kill(os.getpid(), signal.SIGKILL)
 
 

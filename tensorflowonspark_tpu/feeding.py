@@ -34,6 +34,8 @@ from typing import Any, Iterable, Sequence
 
 from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
+from tensorflowonspark_tpu.telemetry import trace as ttrace
+from time import monotonic as _monotonic
 
 
 class FeedQueues:
@@ -167,6 +169,19 @@ class DataFeed:
         # is the proof the previous batch was handed over); the watermark
         # only ever lags, which can over-requeue but never drop.
         self._closed_unreported: list = []
+        # rolling feed-queue occupancy (the autoscaling signal
+        # cluster.stats() serves per node); set at batch boundaries
+        self._occupancy = telemetry.gauge("feed.queue_depth")
+        # partition-consume tracing: the first data item after the previous
+        # EndPartition anchors the span; the marker's trace ctx (stamped by
+        # a sampled driver partition / serving round) parents it.  The last
+        # popped marker's ctx is exposed as ``last_trace`` so the consumer
+        # (serving_loop) can hang its compute span on the same trace.
+        self._part_t0: float | None = None
+        self.last_trace = None
+        # full-batch marker lookahead (see next_batch): a non-marker item
+        # popped by the lookahead is consumed FIRST on the next call
+        self._pending = None
 
     # -- consuming -----------------------------------------------------------
 
@@ -181,22 +196,27 @@ class DataFeed:
         q = self.queues.get_queue(self.qname_in)
         batch: list = []
         while len(batch) < batch_size:
-            try:
-                # fast path: drain already-buffered items without the timed
-                # get's condition-wait machinery — at zero-copy feed rates
-                # the queue is rarely empty and the per-item overhead shows
-                item = q.get_nowait()
-            except queue.Empty:
-                if self.stop_event is not None and self.stop_event.is_set():
-                    self.done_feeding = True
-                    break
+            if self._pending is not None:
+                item, self._pending = self._pending, None
+            else:
                 try:
-                    item = q.get(timeout=self.poll_interval)
+                    # fast path: drain already-buffered items without the
+                    # timed get's condition-wait machinery — at zero-copy
+                    # feed rates the queue is rarely empty and the per-item
+                    # overhead shows
+                    item = q.get_nowait()
                 except queue.Empty:
-                    continue
+                    if self.stop_event is not None and self.stop_event.is_set():
+                        self.done_feeding = True
+                        break
+                    try:
+                        item = q.get(timeout=self.poll_interval)
+                    except queue.Empty:
+                        continue
             if isinstance(item, EndPartition):
                 # the marker is FIFO-last for its partition: popping it means
                 # every item of that partition left the queue
+                self._note_partition_trace(item)
                 if batch:
                     # the batch closing this partition still has to reach the
                     # map_fun — defer the consumption report (see __init__)
@@ -212,8 +232,36 @@ class DataFeed:
                 break
             if isinstance(item, Marker):
                 continue
+            if self._part_t0 is None:
+                self._part_t0 = _monotonic()
             batch.append(item)
+        if len(batch) >= batch_size:
+            # marker lookahead: an exactly-full batch whose EndPartition is
+            # already queued closes its partition NOW (same deferred-report
+            # semantics as the partial-batch path) — without this, the
+            # marker (and its trace ctx) would only pop on the NEXT call,
+            # attributing a serving round's consume span to the wrong round
+            nxt = None
+            try:
+                nxt = q.get_nowait()
+            except queue.Empty:  # toslint: allow-silent(no marker buffered yet; handled below)
+                if ttrace.enabled():
+                    # the producer may be mid-enqueue (items drained faster
+                    # than it could append the marker): a bounded wait
+                    # usually catches it; if not, drop the stale ctx so the
+                    # consumer's compute span goes unattributed instead of
+                    # onto the PREVIOUS round's trace
+                    try:
+                        nxt = q.get(timeout=0.002)
+                    except queue.Empty:  # toslint: allow-silent(marker genuinely late; next call pops it)
+                        self.last_trace = None
+            if isinstance(nxt, EndPartition):
+                self._note_partition_trace(nxt)
+                self._closed_unreported.append(getattr(nxt, "key", None))
+            elif nxt is not None:
+                self._pending = nxt
         if batch:
+            self._occupancy.set(q.qsize())
             telemetry.counter("feed.batches").inc()
             telemetry.counter("feed.rows_consumed").inc(len(batch))
             # Chaos hook (no-op unless TOS_FAULTINJECT armed a `kill`): a
@@ -226,6 +274,20 @@ class DataFeed:
 
     def _to_columns(self, batch: list) -> dict:
         return batch_to_columns(batch, self.input_mapping)
+
+    def _note_partition_trace(self, item: EndPartition) -> None:
+        """Close out a popped EndPartition's trace: records the node-side
+        partition-consume span (first queued item seen -> marker popped)
+        under the driver's partition/round span and publishes the ctx as
+        ``last_trace`` for the consumer's own compute span."""
+        ctx = getattr(item, "trace", None)
+        self.last_trace = ctx
+        t0, self._part_t0 = self._part_t0, None
+        if ctx is not None:
+            now = _monotonic()
+            ttrace.record_child("feed.partition_consume", ctx,
+                                t0 if t0 is not None else now,
+                                now - t0 if t0 is not None else 0.0)
 
     # -- producing results (inference path) ----------------------------------
 

@@ -36,22 +36,29 @@ import queue
 import threading
 from typing import Any, Iterable
 
+from time import monotonic as _monotonic
+
 from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.feeding import FeedQueues, batch_to_columns
 from tensorflowonspark_tpu.ingest.readers import ReaderPipeline, ShardDone
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 
 
 class _PartitionJob:
     """Watermark bookkeeping for one ledger partition of shard paths."""
 
-    __slots__ = ("key", "n_shards", "n_done", "closed")
+    __slots__ = ("key", "n_shards", "n_done", "closed", "trace", "t0")
 
     def __init__(self):
         self.key = None
         self.n_shards = 0
         self.n_done = 0
         self.closed = False
+        # sampled driver partition's trace ctx (rides the EndPartition) +
+        # first-claim time: the ingest partition-consume span's anchors
+        self.trace = None
+        self.t0 = _monotonic()
 
 
 class IngestFeed:
@@ -104,6 +111,10 @@ class IngestFeed:
             readers=readers, autotune=autotune, prefetch=prefetch,
             chunk_records=chunk_records, decode=decode, verify=verify,
             stop_event=self._abandon)
+        # rolling feed-queue occupancy (the autoscaling signal
+        # cluster.stats() serves per node, same gauge as DataFeed): in
+        # DIRECT mode the reader pipeline's prefetch queue IS the feed queue
+        self._occupancy = telemetry.gauge("feed.queue_depth")
         # partitions fully read AND fully handed to the map_fun, awaiting
         # the safe moment to report (see _report_ready_keys)
         self._jobs_lock = threading.Lock()
@@ -134,12 +145,13 @@ class IngestFeed:
                     open_job = None
                     with self._jobs_lock:
                         job.key = getattr(item, "key", None)
+                        job.trace = getattr(item, "trace", None)
                         job.closed = True
                         if job.n_done >= job.n_shards:
                             # every shard already drained through the
                             # consumer (or the partition was empty): ready —
                             # the consumer reports it at its next safe point
-                            self._ready_keys.append(job.key)
+                            self._ready_keys.append(job)
                     continue
                 if isinstance(item, EndOfFeed):
                     return
@@ -175,9 +187,20 @@ class IngestFeed:
         with self._jobs_lock:
             if not self._ready_keys:
                 return
-            keys, self._ready_keys = self._ready_keys, []
-        for key in keys:
-            self.queues.note_partition_consumed(self.qname_in, key)
+            jobs, self._ready_keys = self._ready_keys, []
+        for job in jobs:
+            self._report_job(job)
+
+    def _report_job(self, job: _PartitionJob) -> None:
+        self.queues.note_partition_consumed(self.qname_in, job.key)
+        if job.trace is not None:
+            # ingest partition-consume span: first shard claimed -> every
+            # record handed to the map_fun (under the driver's sampled
+            # train.partition span — the DIRECT-mode end of the trace)
+            now = _monotonic()
+            ttrace.record_child("feed.partition_consume", job.trace,
+                                job.t0, now - job.t0,
+                                {"shards": job.n_shards})
 
     def _on_shard_done(self, token: ShardDone, batch_empty: bool) -> None:
         job = token.tag
@@ -195,10 +218,9 @@ class IngestFeed:
                     # come: the elastic tail drain polls this watermark)
                     report = True
                 else:
-                    self._ready_keys.append(job.key)
-                key = job.key
+                    self._ready_keys.append(job)
         if report:
-            self.queues.note_partition_consumed(self.qname_in, key)
+            self._report_job(job)
 
     def next_batch(self, batch_size: int) -> list | dict:
         """Pop up to ``batch_size`` decoded records; the batch goes partial
@@ -259,6 +281,7 @@ class IngestFeed:
                 continue
             self._leftover = item  # one decoded chunk (a list)
         if batch:
+            self._occupancy.set(self.pipeline.depth())
             telemetry.counter("feed.batches").inc()
             telemetry.counter("feed.rows_consumed").inc(len(batch))
             # same chaos clock as DataFeed: `kill:after_batches=N` fires on

@@ -158,6 +158,10 @@ class ReaderPipeline:
 
     # -- consumer side -------------------------------------------------------
 
+    def depth(self) -> int:
+        """Decoded chunks queued ahead of the consumer (0 in sync mode)."""
+        return self._out.qsize()
+
     def get(self, timeout: float = 0.25):
         """Pop the next item: a list of records (one decoded chunk), a
         :class:`ShardDone` token, or ``None`` once the pipeline has fully

@@ -28,12 +28,17 @@ class EndPartition(Marker):
     consumption watermark must count such a pair once, or it over-advances
     past still-buffered work that a later death would then fail to
     re-deliver.  ``None`` (legacy/no-ledger feeds) counts every pop.
+
+    ``trace`` (optional) is the sampled request/partition's trace context
+    ``(trace_id, span_id)``: the consumer's partition-consume span parents
+    onto it, closing the cross-process loop (``telemetry.trace``).
     """
 
-    __slots__ = ("key",)
+    __slots__ = ("key", "trace")
 
-    def __init__(self, key=None):
+    def __init__(self, key=None, trace=None):
         self.key = key
+        self.trace = trace
 
 
 class EndOfFeed(Marker):

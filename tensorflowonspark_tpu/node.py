@@ -414,6 +414,13 @@ def node_main(config: NodeConfig) -> int:
     # dead) is fenced by the coordinator instead of racing its replacement.
     client.set_identity(executor_id, incarnation)
     faultinject.set_identity(executor_id, incarnation)
+    if config.log_dir:
+        # chaos-kill postmortem: a `kill` fault dumps this process's flight
+        # recorder (recent spans + events) next to the job logs before the
+        # SIGKILL — the one record of the node's last seconds that survives
+        faultinject.set_flight_dump(
+            os.path.join(config.log_dir, f"flight_node{executor_id}.json"),
+            node=f"node{executor_id}")
     cluster_info = client.await_cluster(timeout=config.reservation_timeout)
 
     # Heartbeats must start IMMEDIATELY after registration — before
@@ -459,7 +466,9 @@ def node_main(config: NodeConfig) -> int:
             _enter_stop_state()
             return
         from tensorflowonspark_tpu import telemetry
+        from tensorflowonspark_tpu.telemetry import trace as ttrace
 
+        tracer = ttrace.get_tracer()
         failures = 0
         metrics_state: dict | None = None
         while not stop_requested.is_set():
@@ -474,23 +483,35 @@ def node_main(config: NodeConfig) -> int:
                 # cumulative values, changed keys only): the cluster metrics
                 # transport costs zero extra round-trips, and a delta lost
                 # with a failed ping is re-sent implicitly by the next one.
+                # The trace delta (new spans + flight events, stamped with
+                # the current clock-offset estimate) rides the same ping.
                 payload: dict | None = None
+                trace_payload: dict | None = None
                 if telemetry.enabled():
                     payload, metrics_state = telemetry.collect_changed(
                         metrics_state)
+                trace_payload = tracer.collect_delta()
                 stop = hb_client.heartbeat(executor_id,
-                                           metrics=payload or None)
+                                           metrics=payload or None,
+                                           trace=trace_payload)
+                # feed the round-trip's clock estimate back to the tracer
+                # (best-RTT midpoint wins; used by export + flight dumps)
+                if hb_client.last_clock_offset is not None:
+                    tracer.note_clock(hb_client.last_clock_offset,
+                                      hb_client.last_rtt)
                 failures = 0
             except Exception:
                 failures += 1
                 # the delta that rode the failed ping may be lost: drop the
                 # dedupe state so the next successful ping re-sends a full
                 # snapshot (values are absolute — re-sending is idempotent),
-                # and give the drained span samples back to their outboxes
-                # (the one part of a delta that is NOT re-derivable)
+                # give the drained span samples back to their outboxes, and
+                # give the trace delta back to the tracer — spans/flight
+                # events are the parts of a delta that are NOT re-derivable
                 metrics_state = None
                 if payload:
                     telemetry.get_registry().restore_recent(payload)
+                tracer.restore_delta(trace_payload)
                 if failures >= 3:
                     # Coordinator gone (driver exited/crashed): treat exactly
                     # like a stop signal so map_fun unblocks instead of
@@ -615,10 +636,21 @@ def node_main(config: NodeConfig) -> int:
             # last heartbeat (tail batches, the map_fun span itself) must
             # still reach the driver's cluster view.
             from tensorflowonspark_tpu import telemetry
+            from tensorflowonspark_tpu.telemetry import trace as ttrace
 
+            # The tracer drain is single-consumer: wait for the heartbeat
+            # thread (the in-run consumer) to see the stop flag before the
+            # final drain, else a failed in-flight ping could restore_delta
+            # AFTER collect_final and strand those spans (or rewind a ring
+            # cursor mid-drain).  A wedged ping forfeits the final trace
+            # rather than racing for it — metrics stay safe either way
+            # (absolute values, idempotent).
+            hb.join(config.heartbeat_interval + 10.0)
             final_metrics = (telemetry.collect_changed(None)[0]
                              if telemetry.enabled() else None)
-            client.deregister(executor_id, metrics=final_metrics or None)
+            client.deregister(executor_id, metrics=final_metrics or None,
+                              trace=(ttrace.collect_final()
+                                     if not hb.is_alive() else None))
         except Exception:
             logger.debug("deregister failed during teardown (driver may "
                          "flag this exit as a death)", exc_info=True)

@@ -31,6 +31,7 @@ from time import monotonic as _monotonic
 from typing import Any, Callable, Sequence
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -59,7 +60,7 @@ class _Request:
 
     __slots__ = ("rows", "results", "remaining", "offset", "error",
                  "event", "deadline", "t_submit", "dispatched_at",
-                 "callbacks")
+                 "callbacks", "trace", "resolved_at")
 
     def __init__(self, rows: list, deadline: float):
         self.rows = rows
@@ -74,6 +75,10 @@ class _Request:
         # done callbacks (the reactor frontend's completion path); invoked
         # exactly once, never with the batcher lock held
         self.callbacks: list = []
+        # sampled request's trace context (None = unsampled/tracing off);
+        # the root serve.request span records at resolution
+        self.trace = None
+        self.resolved_at: float | None = None
 
 
 class MicroBatch:
@@ -82,7 +87,8 @@ class MicroBatch:
     entries that scatter results back to their waiters.  ``retries`` counts
     re-dispatches after a replica failure (the router allows one)."""
 
-    __slots__ = ("rows", "n", "entries", "retries", "created_at")
+    __slots__ = ("rows", "n", "entries", "retries", "created_at",
+                 "trace", "trace_parent")
 
     def __init__(self, rows: list, n: int,
                  entries: list[tuple[_Request, int, int, int]]):
@@ -91,6 +97,12 @@ class MicroBatch:
         self.entries = entries
         self.retries = 0
         self.created_at = _monotonic()
+        # batch span context: derived from the FIRST sampled request in the
+        # batch (the batcher "links N request spans to their batch span" —
+        # the other sampled requests are listed in the span's link tags);
+        # the router/wire/node spans all parent onto this ctx
+        self.trace = None
+        self.trace_parent: int | None = None
 
 
 class PendingPrediction:
@@ -184,6 +196,10 @@ class MicroBatcher:
                         "retry later or add replicas"))
                     continue
                 req = _Request(rows, deadline)
+                # gateway-side trace stamping: the deterministic sampler
+                # (TOS_TRACE_SAMPLE) decides here, once, for the request's
+                # whole cross-process life; None costs one check downstream
+                req.trace = ttrace.sample()
                 if done_cb is not None:
                     req.callbacks.append(done_cb)
                 self._queue.append(req)
@@ -326,6 +342,9 @@ class MicroBatcher:
                 req.dispatched_at = now
                 telemetry.histogram("serve.queue_wait_secs").observe(
                     now - req.t_submit)
+                # stage span: admission wait (submit -> pulled into a batch)
+                ttrace.record_child("serve.admission", req.trace,
+                                    req.t_submit, now - req.t_submit)
             req.offset += take
             if req.offset >= len(req.rows):
                 self._queue.popleft()
@@ -336,12 +355,21 @@ class MicroBatcher:
         telemetry.histogram("serve.batch_fill").observe(n / self.max_batch)
         # pad to the static batch shape: the jitted apply compiles once
         rows.extend(rows[-1] for _ in range(self.max_batch - n))
-        return MicroBatch(rows, n, entries)
+        batch = MicroBatch(rows, n, entries)
+        sampled = [r for r, _roff, _cnt, _boff in entries
+                   if r.trace is not None]
+        if sampled:
+            # batch span under the first sampled request; the rest are
+            # linked by id so their traces reach this batch in the export
+            batch.trace = ttrace.derive(sampled[0].trace)
+            batch.trace_parent = sampled[0].trace[1]
+        return batch
 
     # -- completion (router threads) -----------------------------------------
 
     def complete_batch(self, batch: MicroBatch, results: list) -> None:
         """Scatter one batch's results back to each waiter (positional)."""
+        self._record_batch_span(batch)
         with self._cond:
             for req, roff, cnt, boff in batch.entries:
                 if req.event.is_set():
@@ -358,6 +386,7 @@ class MicroBatcher:
         spanning request whose later rows are still queued is pulled out —
         one error answers the whole request, and scoring its tail would be
         wasted replica work charged against the admission bound."""
+        self._record_batch_span(batch, error=error)
         with self._cond:
             for req, _roff, _cnt, _boff in batch.entries:
                 if not req.event.is_set():
@@ -372,11 +401,40 @@ class MicroBatcher:
             self._cond.notify_all()
         self._fire_done()
 
+    def _record_batch_span(self, batch: MicroBatch,
+                           error: Exception | None = None) -> None:
+        """Record the serve.batch span (build -> scatter) with its request
+        links; called OUTSIDE the lock, once per batch resolution."""
+        if batch.trace is None:
+            return
+        tags: dict = {"rows": batch.n, "retries": batch.retries}
+        links = [[r.trace[0], r.trace[1]]
+                 for r, _roff, _cnt, _boff in batch.entries
+                 if r.trace is not None]
+        if len(links) > 1:
+            tags["links"] = links[1:]
+        if error is not None:
+            tags["error"] = type(error).__name__
+        ttrace.record_span("serve.batch", batch.trace, batch.trace_parent,
+                           batch.created_at, _monotonic() - batch.created_at,
+                           tags)
+
     def _finish_locked(self, req: _Request, error: Exception | None) -> None:
         req.error = error
+        req.resolved_at = _monotonic()
         if error is None:
             telemetry.histogram("serve.request_secs").observe(
-                _monotonic() - req.t_submit)
+                req.resolved_at - req.t_submit)
+        if req.trace is not None:
+            # root span: the whole request, submit -> resolution (stage
+            # spans — admission/batch_fill/wire/node_round/reply — nest
+            # under it in the merged trace)
+            tags = {"rows": len(req.rows)}
+            if error is not None:
+                tags["error"] = type(error).__name__
+            ttrace.record_span("serve.request", req.trace, None,
+                               req.t_submit, req.resolved_at - req.t_submit,
+                               tags)
         req.event.set()
         if req.callbacks:
             self._done_pending.append(req)

@@ -61,6 +61,7 @@ import threading
 from time import monotonic as _monotonic
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.dataserver import (  # shared framing constants
     _LEN,
     _MAX_SECTIONS,
@@ -540,6 +541,13 @@ class ReactorFrontend:
                 entry[2] = entry[3] = None  # unpin; heap drops it on expiry
             if self._conns.get(conn.fd) is not conn:
                 continue  # client gone; reply dropped
+            if req.trace is not None and req.resolved_at is not None:
+                # stage span: reply (request resolved -> its frame queued on
+                # the reactor); the kernel write that follows is the one
+                # part of the path no span can cover from this side
+                ttrace.record_child("serve.reply", req.trace,
+                                    req.resolved_at,
+                                    _monotonic() - req.resolved_at)
             grouped.setdefault(conn, []).append(self._reply_entry(req, rid))
         if drained:
             self._outstanding_gauge.set(self._n_outstanding)

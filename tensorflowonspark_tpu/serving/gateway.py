@@ -41,6 +41,7 @@ from time import monotonic as _monotonic
 from typing import Any, Sequence
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.dataserver import _recv, _send
 from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401 - CTL_KEY re-exported
     CTL_KEY,
@@ -187,6 +188,8 @@ class ServingGateway:
                 acks = self._router.broadcast_ctl(
                     {CTL_KEY: "reload", "export_dir": self.export_dir})
                 telemetry.counter("serve.reloads_total").inc()
+                ttrace.event("reload", export_dir=self.export_dir,
+                             replicas=sorted(acks))
                 logger.info("serving bundle reloaded on replicas %s",
                             sorted(acks))
                 return acks
@@ -254,17 +257,20 @@ class _GatewayFuture:
     ``result()`` blocks until the id-matched reply arrives and returns the
     results or raises the mapped gateway error."""
 
-    __slots__ = ("_event", "_reply", "_error", "_timeout", "_deadline")
+    __slots__ = ("_event", "_reply", "_error", "_timeout", "_slack",
+                 "_deadline")
 
-    def __init__(self, timeout: float):
+    def __init__(self, timeout: float, slack: float = 30.0):
         self._event = threading.Event()
         self._reply: tuple | None = None
         self._error: Exception | None = None
         self._timeout = timeout
+        self._slack = slack
         # client-side hang detector: the gateway answers every accepted
         # request by its server-side deadline, so a reply this overdue
-        # means the connection is dead, not slow
-        self._deadline = _monotonic() + timeout + 30.0
+        # (TOS_SERVE_CLIENT_SLACK past it) means the connection is dead,
+        # not slow
+        self._deadline = _monotonic() + timeout + slack
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -283,7 +289,7 @@ class _GatewayFuture:
         gateway answers every accepted request, so this should only fire
         when the server is unreachable (then the receiver poisons the
         client and resolves every future with the connection error)."""
-        budget = timeout if timeout is not None else self._timeout + 30.0
+        budget = timeout if timeout is not None else self._timeout + self._slack
         if not self._event.wait(budget):
             raise ServeTimeout(
                 f"no gateway reply within the client-side budget ({budget:.1f}s)")
@@ -324,6 +330,9 @@ class GatewayClient:
             self._sock.close()
             raise RuntimeError("gateway auth handshake failed")
         self._call_timeout = call_timeout
+        # reply-reaper backstop past the server-enforced deadline: how much
+        # grace an overdue reply gets before the connection is presumed dead
+        self._slack = env_float("TOS_SERVE_CLIENT_SLACK", 30.0)
         # frame-write serializer: interleaved sendmsg from two threads would
         # interleave frame bytes (same deliberate hold-lock-across-I/O
         # pattern as DataClient._call; baselined in analysis/baseline.json)
@@ -351,7 +360,7 @@ class GatewayClient:
                 raise ServeClosed("gateway client is closed")
             rid = self._next_id
             self._next_id += 1
-            fut = _GatewayFuture(timeout)
+            fut = _GatewayFuture(timeout, self._slack)
             self._pending[rid] = fut
         try:
             with self._send_lock:

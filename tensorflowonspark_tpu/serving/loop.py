@@ -42,6 +42,7 @@ def serving_loop(args, ctx) -> None:
     from tensorflowonspark_tpu.inference import _arg, rows_to_features
     from tensorflowonspark_tpu.models.registry import build_apply
     from tensorflowonspark_tpu.serving.batcher import CTL_KEY
+    from tensorflowonspark_tpu.telemetry import trace as ttrace
     from tensorflowonspark_tpu.utils.envtune import env_int
 
     export_dir = _arg(args, "export_dir")
@@ -81,7 +82,12 @@ def serving_loop(args, ctx) -> None:
         # gateway batches arrive pre-padded (len == max_batch); pad here too
         # so direct infer_partition callers get the same single-compile apply
         padded = list(items) + [items[-1]] * (max_batch - n)
-        with ctx.metrics.timed("serve.node_batch_secs"):
+        # a sampled round's ctx rode the EndPartition that closed this batch
+        # (feed.last_trace): the pure-compute span separates model time from
+        # the node_round span's queue wait in the merged trace
+        with ctx.metrics.timed("serve.node_batch_secs"), \
+                ttrace.span("serve.node_compute",
+                            parent=getattr(feed, "last_trace", None)):
             x = rows_to_features(padded, input_mapping)
             out = apply_fn(variables, x)
         if isinstance(out, dict):

@@ -47,6 +47,7 @@ from tensorflowonspark_tpu.serving.batcher import (
     MicroBatch,
     MicroBatcher,
 )
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -168,11 +169,22 @@ class ReplicaRouter:
                 self._update_outstanding_locked()
             error: Exception | None = None
             results: list | None = None
+            if batch.trace is not None and batch.retries == 0:
+                # stage span: batch fill/hold (built -> wire call starts;
+                # capacity holds and router queueing both land here).  Only
+                # the first dispatch records it — a retried batch would emit
+                # a second fill span spanning the failed wire attempt too
+                ttrace.record_child(
+                    "serve.batch_fill", batch.trace, batch.created_at,
+                    _monotonic() - batch.created_at)
             try:
                 client = self._client_for(rep)
-                with telemetry.timed("serve.batch_secs"):
+                with telemetry.timed("serve.batch_secs"), \
+                        ttrace.span("serve.wire", parent=batch.trace,
+                                    tags={"executor": rep.executor_id}) as ws:
                     results = client.infer_round(
-                        batch.rows, self.qname_in, self.qname_out)
+                        batch.rows, self.qname_in, self.qname_out,
+                        trace=ws.ctx)
             except Exception as e:  # noqa: BLE001 - retried/surfaced below
                 error = e
             rerouted: list[MicroBatch] = []
@@ -198,6 +210,8 @@ class ReplicaRouter:
         if batch.retries < 1:
             batch.retries += 1
             telemetry.counter("serve.retries_total").inc()
+            ttrace.event("retry", executor=failed_eid, rows=batch.n,
+                         error=str(error)[:200])
             logger.warning("retrying in-flight batch from dead replica %d "
                            "on a live replica", failed_eid)
             self.submit(batch, exclude=failed_eid)
@@ -216,6 +230,7 @@ class ReplicaRouter:
         if rep.healthy:
             rep.healthy = False
             telemetry.counter("serve.replica_failures").inc()
+            ttrace.event("replica_unhealthy", executor=rep.executor_id)
         stale, rep.client = rep.client, None
         if stale is not None:
             with contextlib.suppress(Exception):
@@ -316,6 +331,8 @@ class ReplicaRouter:
             with contextlib.suppress(Exception):
                 client.close()
             return False
+        ttrace.event("resync", executor=rep.executor_id, incarnation=inc,
+                     readmitted=True)
         logger.info("serving replica %d recovered (incarnation %d)",
                     rep.executor_id, inc)
         return True

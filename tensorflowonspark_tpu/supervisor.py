@@ -37,6 +37,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int
 from tensorflowonspark_tpu.utils.net import backoff_delay
 
@@ -134,6 +135,8 @@ class Supervisor:
         with self._lock:
             self._permanent[executor_id] = reason
         telemetry.counter("elastic.permanent_failures").inc()
+        ttrace.event("permanent_failure", executor=executor_id,
+                     reason=reason[:200])
         logger.error("executor %d permanently failed: %s", executor_id, reason)
         # Surface through the node-error channel and fail fast, exactly like
         # the non-elastic path would have on first death.
@@ -212,6 +215,8 @@ class Supervisor:
                 # the slot's ports/devices before its replacement takes them.
                 self.launcher.respawn(launch_index, config)
                 telemetry.counter("elastic.restarts_total").inc()
+                ttrace.event("restart", executor=executor_id,
+                             attempt=attempt + 1)
                 logger.info("executor %d respawned (launch_index %d, restart %d)",
                             executor_id, launch_index, attempt + 1)
                 if self._await_reregister(executor_id):

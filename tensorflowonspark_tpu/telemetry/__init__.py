@@ -12,9 +12,16 @@ The framework's observability substrate (stdlib-only):
   merges them into a per-node store and serves the aggregated cluster view
   through a ``metrics`` control-plane op (``coordinator.py``).
 - **Sinks** — ``cluster.metrics()`` (aggregated dict), ``cluster.
-  debug_dump()`` (text), periodic TensorBoard scalar export through
+  debug_dump()`` (text), ``cluster.stats()`` (rolling-window live stats,
+  the ``statz`` op), periodic TensorBoard scalar export through
   ``summary.SummaryWriter``, and an end-of-run JSON run report written at
-  shutdown (``cluster.py``; ``report.py`` builds all three).
+  shutdown (``cluster.py``; ``report.py`` builds the aggregates).
+- **Distributed tracing + flight recorder** — ``trace.py``: sampled
+  spans with cross-process context propagation (``TOS_TRACE``), shipped
+  on the same heartbeats and merged into a Perfetto-loadable
+  ``trace.json`` by ``trace_export.py``; a bounded ring of structured
+  events (deaths/restarts/retries/resyncs/reloads/faults) feeds the run
+  report's ``"flight"`` timeline and crash dumps.
 
 Master switch: ``TOS_METRICS`` (default on).  Disabled, every accessor
 returns a shared no-op object, so instrumentation costs one dict miss.
@@ -47,6 +54,7 @@ from tensorflowonspark_tpu.telemetry.report import (  # noqa: F401
     debug_dump,
     write_run_report,
 )
+from tensorflowonspark_tpu.telemetry import trace  # noqa: F401
 
 _lock = threading.Lock()
 _registry: MetricsRegistry | None = None

@@ -353,6 +353,18 @@ class MetricsRegistry:
                  "hist_counts": hist_counts}
         return payload, state
 
+    def drain_recent(self) -> dict[str, list[float]]:
+        """Drain every histogram's outbox: the samples observed since the
+        last drain, per name.  Used by the DRIVER's rolling-stats sampler
+        (the driver sends no heartbeats, so its outboxes have no other
+        consumer); node processes must leave this to ``collect_changed``."""
+        out: dict[str, list[float]] = {}
+        for name, h in list(self._histograms.items()):
+            recent = h.drain_outbox()
+            if recent:
+                out[name] = recent
+        return out
+
     def restore_recent(self, payload: dict | None) -> None:
         """Return a failed delta's drained histogram samples to their
         outboxes (``collect_changed`` drains destructively, and counters/

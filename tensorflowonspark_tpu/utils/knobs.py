@@ -107,6 +107,10 @@ _ALL = (
          "Cap on concurrent chunk SENDS across all node connections in "
          "train()/inference() (permit per chunk, never held across a "
          "partition); 0 = unlimited."),
+    Knob("TOS_SERVE_CLIENT_SLACK", "float", "30",
+         "GatewayClient reply-reaper backstop: extra seconds past the "
+         "server-enforced request deadline before an unanswered reply "
+         "marks the connection dead (the client then poisons it)."),
     Knob("TOS_SERVE_CONN_OUTSTANDING", "int", "128",
          "Serving frontend: max pipelined requests outstanding per client "
          "connection; excess requests get the fast-fail 'unavailable' "
@@ -140,6 +144,18 @@ _ALL = (
     Knob("TOS_SHUTDOWN_TIMEOUT", "float", "120",
          "Budget for shutdown() to join node processes before escalating "
          "to terminate/kill."),
+    Knob("TOS_TRACE", "bool", "0",
+         "Distributed request tracing master switch: 1 records sampled "
+         "spans into per-thread rings, ships them on heartbeats, and "
+         "writes trace_*.json + a merged Perfetto trace.json at shutdown."),
+    Knob("TOS_TRACE_SAMPLE", "float", "0.01",
+         "Trace sampling rate in (0, 1]: every round(1/rate)-th root "
+         "(request / train partition) is traced, deterministically "
+         "(counter-based, not random); 1 traces everything."),
+    Knob("TOS_FLIGHT_EVENTS", "int", "256",
+         "Flight-recorder ring capacity per process (structured "
+         "death/restart/retry/resync/reload/fault events, independent of "
+         "TOS_TRACE); 0 disables the recorder."),
 )
 
 KNOBS: dict[str, Knob] = {k.name: k for k in _ALL}

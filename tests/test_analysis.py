@@ -626,6 +626,64 @@ def test_metrics_quiet_inside_telemetry_package():
         """, f"{PKG}/telemetry/registry.py", "metrics-discipline") == []
 
 
+def test_span_discipline_fires_on_bad_span_names():
+    """Span names recorded through telemetry.trace must be dotted lowercase
+    (the metric-name convention) — ad-hoc spellings fragment the merged
+    trace's subsystem grouping."""
+    found = lint(
+        """
+        from tensorflowonspark_tpu.telemetry import trace as ttrace
+        def f(ctx, t0):
+            with ttrace.span("WireCall", parent=ctx):
+                pass
+            ttrace.record_span("onewordname", ctx, None, t0, 0.1)
+            ttrace.record_child("serve.Reply", ctx, t0, 0.1)
+        """, f"{PKG}/somemod.py", "metrics-discipline")
+    assert {f.anchor for f in found} == {
+        "f@span:WireCall", "f@span:onewordname", "f@span:serve.Reply"}
+    assert all("dotted-lowercase" in f.hint for f in found)
+
+
+def test_span_discipline_fires_on_module_level_span_buffers():
+    found = lint(
+        """
+        import collections
+        _SPANS = []
+        trace_buffer = collections.deque()
+        """, f"{PKG}/somemod.py", "metrics-discipline")
+    assert {f.anchor for f in found} == {
+        "<module>@_SPANS", "<module>@trace_buffer"}
+
+
+def test_span_discipline_quiet_on_sanctioned_usage():
+    # dotted-lowercase names through the tracer, and non-span containers
+    assert lint(
+        """
+        from tensorflowonspark_tpu.telemetry import trace as ttrace
+        def f(ctx, t0):
+            with ttrace.span("serve.wire", parent=ctx):
+                pass
+            ttrace.record_child("feed.partition_consume", ctx, t0, 0.1)
+        def g(name, ctx, t0):
+            ttrace.record_span(name, ctx, None, t0, 0.1)  # dynamic: not ours
+        _ROUTES = []
+        """, f"{PKG}/somemod.py", "metrics-discipline") == []
+    # an unrelated .span() method is not our API (re.Match.span takes a
+    # group name, not a span name) — must not fire
+    assert lint(
+        """
+        import re
+        def h(text):
+            m = re.match(r"(?P<word>\\\\w+)", text)
+            return m.span("word")
+        """, f"{PKG}/somemod.py", "metrics-discipline") == []
+    # the tracer implementation itself is exempt
+    assert lint(
+        """
+        _SPANS = []
+        """, f"{PKG}/telemetry/trace.py", "metrics-discipline") == []
+
+
 # -- baseline round-trip + ids ------------------------------------------------
 
 _VIOLATION = """

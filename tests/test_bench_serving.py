@@ -7,6 +7,8 @@ runs on an idle box)."""
 
 from __future__ import annotations
 
+import pytest
+
 
 def test_bench_serving_quick_config_runs(monkeypatch):
     monkeypatch.setenv("TOS_SHM_RING", "0")
@@ -28,3 +30,26 @@ def test_bench_serving_quick_config_runs(monkeypatch):
     # the table renderer stays in sync with the result schema
     table = bench_serving.markdown_table(results)
     assert "1row_tcp_pipe" in table and "qps" in table
+
+
+def test_bench_serving_trace_mode_renderer_and_flag():
+    """--trace-breakdown schema: the renderer and the CLI flag stay in sync
+    with the result shape (the full traced run itself is exercised by
+    BENCH_r10 runs and tests/test_trace.py's e2e — not re-run here, the
+    smoke budget is one cluster)."""
+    import bench_serving
+
+    results = {
+        "mode": "trace-breakdown",
+        "compare": {"qps_off": [100.0, 110.0], "qps_on": [99.0, 108.0],
+                    "best_off": 110.0, "best_on": 108.0,
+                    "on_overhead_pct": 1.82},
+        "breakdown": {"load": {"qps": 100.0},
+                      "stages": {"serve.wire": {"n": 5, "p50_ms": 1.5,
+                                                "p99_ms": 3.0}}},
+    }
+    table = bench_serving.trace_table(results)
+    assert "serve.wire" in table and "+1.82%" in table
+    # the flag parses (argparse wiring)
+    with pytest.raises(SystemExit):
+        bench_serving.main(["--help"])

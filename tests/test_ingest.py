@@ -17,6 +17,7 @@ import time
 import pytest
 
 from tensorflowonspark_tpu import cluster as tcluster
+from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu import tfrecord
 from tensorflowonspark_tpu.feeding import FeedQueues
 from tensorflowonspark_tpu.ingest import (
@@ -194,6 +195,9 @@ def test_ingest_feed_drains_and_reports_watermark(tmp_path):
     assert sorted(r.decode() for r in seen) == sorted(ids)
     # every partition fully handed over -> watermark exact
     assert queues.partitions_consumed("input") == 4
+    # DIRECT mode reports the same feed-occupancy gauge as DataFeed (the
+    # per-node signal cluster.stats() serves); fully drained -> depth 0
+    assert telemetry.gauge("feed.queue_depth").value() == 0
 
 
 def test_ingest_feed_dedupes_refed_partition(tmp_path):

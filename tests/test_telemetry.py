@@ -340,6 +340,10 @@ def test_metrics_disabled_cluster_still_trains(tmp_path, monkeypatch):
         assert "driver" not in snap["nodes"]
         cluster.shutdown(timeout=120.0)
         assert not (tmp_path / "logs" / "run_report.json").exists()
+        # TOS_TRACE defaults off: a default-config run leaves ZERO trace
+        # artifacts (the ISSUE-8 acceptance criterion)
+        leftovers = [p.name for p in (tmp_path / "logs").glob("trace*.json")]
+        assert leftovers == [], leftovers
     finally:
         monkeypatch.setenv("TOS_METRICS", "1")
         telemetry.reset()
