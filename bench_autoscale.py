@@ -1,0 +1,308 @@
+"""Elastic-autoscaling bench: serving replicas follow a stepped load.
+
+The closed loop under measurement (ISSUE 9 / BENCH_r11): a serving
+cluster starts at 1 replica, offered load steps **1x -> 4x -> 1x**, and
+``cluster.autoscale`` + ``QueueDepthBandPolicy`` must move the fleet with
+it — scale-out while the 4x step holds, scale back in after it passes —
+with **zero failed requests that are not 503s** across both transitions
+(scale-out rendezvous, scale-in drain).
+
+Load shape: C closed-loop client threads per phase against a
+``max_batch=1`` gateway.  One-row-per-round serialization makes the
+admission-queue depth track the offered concurrency itself (depth ~=
+clients - replicas-in-service, whatever the box's service rate), so the
+queue-depth band responds to the *step*, not to how fast this machine's
+linear model happens to be — the bench is about the control loop, not
+model throughput.
+
+Recorded per phase: qps/p50/p99, request + error counts, replica count at
+entry/exit.  Recorded globally: a sampled replica/queue-depth trajectory,
+the autoscaler's full decision trail (every ``scale_out`` / ``scale_in``
+/ ``cooldown_hold`` with the stats snapshot that justified it), and the
+acceptance verdict.
+
+Acceptance gate (r11): replicas rise above 1 during the 4x phase, return
+to 1 by the end of the final 1x phase (inside policy cooldowns — the
+tail phase budgets K scale-in windows + cooldown per step down), and no
+request fails with anything but ``ServeQueueFull`` (the 503).
+
+Usage::
+
+    python bench_autoscale.py                  # full run, markdown + JSON
+    python bench_autoscale.py --quick          # short phases (CI smoke)
+    python bench_autoscale.py --json out.json
+
+Run on an otherwise idle box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import threading
+import time
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class _Loader:
+    """One closed-loop client thread; latencies and classified errors are
+    read after ``stop()``."""
+
+    def __init__(self, gateway, feature_dim: int):
+        from tensorflowonspark_tpu.serving import ServeQueueFull
+
+        import numpy as np
+
+        self._gateway = gateway
+        self._rows = [np.arange(feature_dim, dtype=np.float32)]
+        self._503 = ServeQueueFull
+        self._stop = threading.Event()
+        self.latencies: list[float] = []
+        self.errors_503 = 0
+        self.errors_other: list[str] = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self._gateway.predict(self._rows, timeout=60.0)
+                self.latencies.append(time.perf_counter() - t0)
+            except self._503:
+                self.errors_503 += 1
+                time.sleep(0.01)  # a real client would back off on a 503
+            except Exception as e:  # noqa: BLE001 - the acceptance gate counts these
+                self.errors_other.append(f"{type(e).__name__}: {e}")
+                return
+
+    def stop(self, timeout: float = 60.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+
+def _drain_counts(loaders: list[_Loader]) -> tuple[list[float], int, list[str]]:
+    lats = sorted(x for ld in loaders for x in ld.latencies)
+    e503 = sum(ld.errors_503 for ld in loaders)
+    other = [e for ld in loaders for e in ld.errors_other]
+    return lats, e503, other
+
+
+def run_step_scenario(cluster, gateway, scaler, *, feature_dim: int,
+                      phases: list[tuple[str, int, float]],
+                      sample_secs: float = 0.25) -> dict:
+    """Drive the load steps against a live autoscaled cluster.
+
+    ``phases`` is ``[(label, clients, duration_s), ...]``; client threads
+    are added or stopped at each boundary (the mid-run population change
+    IS the step).  A sampler records ``(t, replicas, queue_depth)``
+    throughout, so the trajectory shows the fleet following the load, not
+    just phase-end snapshots.
+    """
+    from tensorflowonspark_tpu import telemetry
+
+    trajectory: list[dict] = []
+    stop_sampling = threading.Event()
+    t_start = time.perf_counter()
+
+    def _sampler() -> None:
+        depth_gauge = telemetry.gauge("serve.queue_depth")
+        while not stop_sampling.wait(sample_secs):
+            trajectory.append({
+                "t": round(time.perf_counter() - t_start, 2),
+                "replicas": cluster.num_feedable(),
+                "healthy": len(gateway.healthy_replicas()),
+                "queue_depth": depth_gauge.value(),
+            })
+
+    sampler = threading.Thread(target=_sampler, daemon=True)
+    sampler.start()
+    loaders: list[_Loader] = []
+    retired: list[_Loader] = []
+    phase_rows: list[dict] = []
+    try:
+        for label, clients, duration in phases:
+            # step DOWN first (stop the excess), then top up to the target
+            while len(loaders) > clients:
+                ld = loaders.pop()
+                ld.stop()
+                retired.append(ld)
+            while len(loaders) < clients:
+                loaders.append(_Loader(gateway, feature_dim))
+            entered = cluster.num_feedable()
+            before = sum(len(ld.latencies) for ld in (*loaders, *retired))
+            t0 = time.perf_counter()
+            time.sleep(duration)
+            elapsed = time.perf_counter() - t0
+            after = sum(len(ld.latencies) for ld in (*loaders, *retired))
+            window = [s for s in trajectory
+                      if t0 - t_start <= s["t"] <= t0 - t_start + elapsed]
+            lats = sorted(x for ld in loaders for x in ld.latencies)
+            phase_rows.append({
+                "phase": label,
+                "clients": clients,
+                "duration_s": round(elapsed, 2),
+                "requests": after - before,
+                "qps": round((after - before) / elapsed, 1),
+                "p50_ms": round(_percentile(lats, 0.50) * 1e3, 2),
+                "p99_ms": round(_percentile(lats, 0.99) * 1e3, 2),
+                "replicas_entry": entered,
+                "replicas_exit": cluster.num_feedable(),
+                "replicas_max": max((s["replicas"] for s in window),
+                                    default=entered),
+            })
+    finally:
+        for ld in loaders:
+            ld.stop()
+        stop_sampling.set()
+        sampler.join(10.0)
+    lats, e503, other = _drain_counts(loaders + retired)
+    return {
+        "phases": phase_rows,
+        "trajectory": trajectory,
+        "requests_total": len(lats),
+        "errors_503": e503,
+        "errors_other": other,
+        "decisions": scaler.report(),
+    }
+
+
+def bench(quick: bool = False) -> dict:
+    """One autoscaled serving cluster through the 1x -> 4x -> 1x step.
+
+    The final 1x phase budgets the scale-in path explicitly: each step
+    down needs ``scale_in_ticks`` consecutive under-band windows plus a
+    cooldown, so its duration is ~(max_nodes - 1) such cycles — replicas
+    must be back at 1 before it ends for the gate to pass.
+    """
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu import serving, telemetry
+    from tensorflowonspark_tpu.autoscale import QueueDepthBandPolicy
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import linear as linmod
+
+    feature_dim = 8
+    base_clients = 2
+    max_nodes = 2 if quick else 3
+    tick = 0.4 if quick else 1.0
+    cooldown = 1.0 if quick else 3.0
+    scale_in_ticks = 2 if quick else 3
+    phases = [("1x", base_clients, 3.0 if quick else 8.0),
+              ("4x", base_clients * 4, 6.0 if quick else 15.0),
+              ("1x", base_clients, 12.0 if quick else 30.0)]
+    config = {"model": "linear", "in_dim": feature_dim,
+              "out_dim": feature_dim}
+    telemetry.reset()
+    results: dict = {
+        "mode": "autoscale-step",
+        "base_clients": base_clients,
+        "bounds": [1, max_nodes],
+        "tick_secs": tick,
+        "cooldown_secs": cooldown,
+        "scale_in_ticks": scale_in_ticks,
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        export = os.path.join(tmp, "bundle")
+        export_bundle(export, linmod.init_params(config, scale=2.0), config)
+        cluster = tcluster.run(
+            serving.serving_loop,
+            {"export_dir": export, "max_batch": 1},
+            num_executors=1,
+            input_mode=tcluster.InputMode.STREAMING,
+            heartbeat_interval=0.5,
+            reservation_timeout=120.0,
+            elastic=True,
+        )
+        try:
+            # max_batch=1 serializes replica rounds: the admission queue
+            # holds exactly the offered concurrency the fleet can't seat,
+            # which is the signal the band policy reads (see module doc)
+            gateway = cluster.serve(export, max_batch=1, max_delay_ms=1.0,
+                                    queue_limit=256, listen=False,
+                                    reload_poll_secs=0)
+            # warmup OUTSIDE the measured phases: compile the first
+            # replica's jitted apply so phase-1 p99 is steady-state
+            warm = _Loader(gateway, feature_dim)
+            time.sleep(0.5)
+            warm.stop()
+            scaler = cluster.autoscale(
+                QueueDepthBandPolicy(low=1.0, high=4.0),
+                min_nodes=1, max_nodes=max_nodes, tick_secs=tick,
+                cooldown_secs=cooldown, scale_in_ticks=scale_in_ticks,
+                window=max(2.0 * tick, 1.5))
+            results["policy"] = scaler.policy.describe()
+            results.update(run_step_scenario(
+                cluster, gateway, scaler, feature_dim=feature_dim,
+                phases=phases))
+        finally:
+            cluster.shutdown(timeout=120.0)
+    rows = {r["phase"]: r for r in results["phases"]}
+    last = results["phases"][-1]
+    results["acceptance"] = {
+        "scaled_out_on_step": rows["4x"]["replicas_max"] > 1,
+        "scaled_back_in": last["replicas_exit"] == 1,
+        "errors_other": len(results["errors_other"]),
+        "errors_503": results["errors_503"],
+    }
+    return results
+
+
+def markdown_table(results: dict) -> str:
+    lines = [f"### autoscaled serving, load step 1x -> 4x -> 1x "
+             f"(bounds={results['bounds']}, tick={results['tick_secs']}s, "
+             f"cooldown={results['cooldown_secs']}s, "
+             f"K={results['scale_in_ticks']})",
+             "| phase | clients | dur s | requests | qps | p50 ms | p99 ms |"
+             " replicas in/max/out |",
+             "|---|---|---|---|---|---|---|---|"]
+    for r in results["phases"]:
+        lines.append(
+            f"| {r['phase']} | {r['clients']} | {r['duration_s']} | "
+            f"{r['requests']:,} | {r['qps']:,.0f} | {r['p50_ms']} | "
+            f"{r['p99_ms']} | {r['replicas_entry']}/{r['replicas_max']}"
+            f"/{r['replicas_exit']} |")
+    counts = results["decisions"]["counts"]
+    lines.append("")
+    lines.append(f"decisions: {counts.get('scale_out', 0)} scale_out, "
+                 f"{counts.get('scale_in', 0)} scale_in, "
+                 f"{counts.get('cooldown_hold', 0)} cooldown_hold, "
+                 f"{counts.get('resize_failures', 0)} resize failures; "
+                 f"{results['requests_total']:,} requests, "
+                 f"{results['errors_503']} x 503, "
+                 f"{len(results['errors_other'])} hard failures")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="short phases / tight ticks (smoke test)")
+    ap.add_argument("--json", default="",
+                    help="also write the raw results to this JSON file")
+    args = ap.parse_args(argv)
+    results = bench(quick=args.quick)
+    print(markdown_table(results))
+    acc = results["acceptance"]
+    ok = (acc["scaled_out_on_step"] and acc["scaled_back_in"]
+          and acc["errors_other"] == 0)
+    print(f"acceptance r11 (replicas follow 1x->4x->1x within policy "
+          f"cooldowns, zero non-503 failures): {'PASS' if ok else 'MISS'} "
+          f"(out={acc['scaled_out_on_step']}, in={acc['scaled_back_in']}, "
+          f"hard failures={acc['errors_other']})")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"raw results -> {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

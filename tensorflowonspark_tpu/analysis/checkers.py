@@ -333,6 +333,10 @@ _THREADED_BASENAMES = frozenset({
     "frontend.py",
     # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer
     "readers.py", "feed.py",
+    # the autoscaling subsystem: the Autoscaler tick thread (loop.py) races
+    # user stop()/report() calls, and the governor (policy.py) is mutated
+    # from whatever thread drives decide()
+    "loop.py", "policy.py",
 })
 _BLOCKING_NAMES = frozenset({
     "recv", "accept", "join", "sleep", "connect_with_backoff",

@@ -1,0 +1,31 @@
+"""Elastic autoscaling: the policy half of ``cluster.resize``.
+
+The mechanism (grow/shrink/rebalance a live cluster) lives in
+``cluster.TPUCluster.resize``; this package supplies what drives it:
+
+- :mod:`~tensorflowonspark_tpu.autoscale.policy` — pure stats->count
+  policies (:class:`QueueDepthBandPolicy`, :class:`LatencyCeilingPolicy`,
+  :class:`RowsPerNodeFloorPolicy`) and the anti-flap
+  :class:`HysteresisGovernor`;
+- :mod:`~tensorflowonspark_tpu.autoscale.loop` — the
+  :class:`Autoscaler` thread composing them over a live cluster
+  (``cluster.autoscale(...)`` starts one).
+"""
+
+from tensorflowonspark_tpu.autoscale.loop import Autoscaler
+from tensorflowonspark_tpu.autoscale.policy import (
+    HysteresisGovernor,
+    LatencyCeilingPolicy,
+    Policy,
+    QueueDepthBandPolicy,
+    RowsPerNodeFloorPolicy,
+)
+
+__all__ = [
+    "Autoscaler",
+    "HysteresisGovernor",
+    "LatencyCeilingPolicy",
+    "Policy",
+    "QueueDepthBandPolicy",
+    "RowsPerNodeFloorPolicy",
+]

@@ -152,14 +152,83 @@ class _PartitionLedger:
         self._watermark: list[int | None] = [None] * num_slots
         self._outstanding = num_partitions * num_epochs
         self._failure: Exception | None = None
+        # slots deliberately drained out mid-run (cluster.resize scale-in):
+        # their next_task answers None even with work outstanding — the
+        # home queue went to the orphan pool and survivors deliver it
+        self._retired_slots: set[int] = set()
         self.max_attempts = max_attempts
+
+    def add_slot(self) -> int:
+        """Admit one more feed slot mid-run (cluster.resize scale-out);
+        returns its position.  The new slot starts with an empty home queue
+        — call :meth:`rebalance_to` to shift pending work onto it, and it
+        drains the shared orphan pool either way."""
+        with self._cond:
+            self._own.append(collections.deque())
+            self._delivered.append(collections.deque())
+            self._watermark.append(None)
+            self._cond.notify_all()
+            return len(self._own) - 1
+
+    def rebalance_to(self, pos: int) -> int:
+        """Move a fair share of still-queued (never-dispatched) home tasks
+        from the most-loaded peers onto slot ``pos`` — how a scale-out
+        newcomer gets work NOW instead of waiting for requeues.  Tasks are
+        taken from the TAIL of peers' queues (their far-future work), so
+        every slot keeps delivering its near-term partitions in order.
+        Returns how many tasks moved."""
+        with self._cond:
+            total = sum(len(q) for q in self._own) + len(self._orphans)
+            slots = len(self._own) - len(self._retired_slots)
+            target = total // max(1, slots)
+            moved = 0
+            while len(self._own[pos]) < target:
+                donor = max((i for i in range(len(self._own))
+                             if i != pos and i not in self._retired_slots),
+                            key=lambda i: len(self._own[i]), default=None)
+                if donor is None or len(self._own[donor]) <= target:
+                    break
+                self._own[pos].append(self._own[donor].pop())
+                moved += 1
+            if moved:
+                self._cond.notify_all()
+            return moved
+
+    def retire_slot(self, pos: int) -> int:
+        """Scale-in: stop assigning slot ``pos`` new work and hand its
+        still-queued home tasks to the orphan pool for survivors to deliver.
+        Its in-flight task (if any) finishes normally, and its
+        acked-but-unconsumed window drains through the usual watermark path
+        (the node consumes its buffered queue in FIFO order before the
+        retirement EOF reaches it).  Returns how many tasks moved."""
+        with self._cond:
+            moved = len(self._own[pos])
+            self._orphans.extend(self._own[pos])
+            self._own[pos].clear()
+            self._retired_slots.add(pos)
+            self._cond.notify_all()
+            return moved
+
+    def slot_idle(self, pos: int) -> bool:
+        """True when the slot has no queued home work and no in-flight feed
+        — the point at which a retirement EOF cannot truncate a partition
+        mid-stream (everything acked is fully buffered ahead of it)."""
+        with self._cond:
+            return not self._own[pos] and pos not in self._inflight
+
+    def slot_retired(self, pos: int) -> bool:
+        with self._cond:
+            return pos in self._retired_slots
 
     def next_task(self, pos: int) -> tuple[int, int] | None:
         """Block until slot ``pos`` has work (home queue first, then orphans)
-        or the feed is over; None means stop (all resolved, or failed)."""
+        or the feed is over; None means stop (all resolved, retired slot, or
+        failed)."""
         with self._cond:
             while True:
                 if self._failure is not None:
+                    return None
+                if pos in self._retired_slots:
                     return None
                 if self._own[pos]:
                     task = self._own[pos].popleft()
@@ -353,6 +422,30 @@ class TPUCluster:
         # Online serving gateways opened via serve(); closed at shutdown so
         # their routers stop before the feed gets its EOFs.
         self._gateways: list = []
+        # Elastic autoscaling (resize / autoscale):
+        # - _resize_lock serializes resize() calls (policy loop + user);
+        # - _train_lock guards the live train() session handle so a
+        #   scale-out can attach a feed worker to an in-flight train();
+        # - _retiring marks slots mid-drain (the monitor treats their death
+        #   as retirement, never as a recovery candidate);
+        # - _audit_waived launch indexes are excluded from shutdown's
+        #   exit-code audit (a retired node we terminated, or one killed
+        #   mid-drain, must not fail the job post-hoc);
+        # - _resize_log / _autoscalers feed the run report's autoscale block;
+        # - _closing gates resize() off (and short-circuits an in-flight
+        #   drain) the moment shutdown begins, so teardown never races a
+        #   resize mutating _feed_ids.
+        self._closing = threading.Event()
+        self._resize_lock = threading.Lock()
+        self._train_lock = threading.Lock()
+        self._train_session: dict | None = None
+        # live inference() calls (guarded by _train_lock): scale-in refuses
+        # while one is in flight — its partitions are statically assigned
+        self._inference_live = 0
+        self._retiring: set[int] = set()
+        self._audit_waived: set[int] = set()
+        self._resize_log: list[dict] = []
+        self._autoscalers: list = []
         # Feed pump: one sender per node connection (the train/inference
         # worker threads), chunk sends pipelined per connection
         # (TOS_SEND_WINDOW in DataClient) and optionally capped fleet-wide
@@ -392,19 +485,56 @@ class TPUCluster:
                            "training continues without them", dead_eval)
             self.coordinator.forget(dead_eval)
         dead_data = [i for i in dead if i in self._feed_ids]
-        if dead_data:
-            return self.coordinator.mark_dead(dead_data,
-                                              record_error=record_error)
-        return dead_data
+        newly: list[int] = []
+        # A slot mid-retirement (resize scale-in) dies ON PURPOSE or at
+        # worst mid-drain: declare it (fence + rendezvous abort) but never
+        # record a fatal node error — the ledger re-feed owns its partitions
+        # and resize owns its teardown, elastic or not.
+        retiring = [i for i in dead_data if i in self._retiring]
+        if retiring:
+            newly.extend(self.coordinator.mark_dead(retiring,
+                                                    record_error=False))
+        rest = [i for i in dead_data if i not in self._retiring]
+        if rest:
+            newly.extend(self.coordinator.mark_dead(rest,
+                                                    record_error=record_error))
+        return newly
+
+    def _requeue_dead_slot(self, executor_id: int) -> None:
+        """A slot's process is gone (death, or kill mid-drain): put its
+        in-flight partition AND its buffered-but-unconsumed window back in
+        play, and tear down its cached data client so no feed worker stays
+        wedged dialing the dead peer."""
+        entry = self._active_ledger.get(executor_id)
+        if entry is not None:
+            entry[0].requeue(entry[1])
+            n = entry[0].requeue_unconsumed(entry[1])
+            if n:
+                logger.warning("re-delivering %d buffered partition(s) "
+                               "node %d died holding", n, executor_id)
+        self._drop_client(executor_id, abort=True)
 
     def _monitor_loop(self) -> None:
         poll = max(1.0, self.heartbeat_interval)
         while not self._monitor_stop.wait(poll):
+            newly = self._record_deaths(
+                record_error=(self.supervisor is None))
+            # Retiring slots first: their death mid-drain is part of the
+            # plan — requeue their ledger window (survivors deliver it) and
+            # never escalate; resize's reaper finalizes the retirement.
+            fatal: list[int] = []
+            for eid in newly:
+                if eid in self._retiring:
+                    logger.warning("retiring node %d died mid-drain; its "
+                                   "partitions re-feed to survivors", eid)
+                    self._requeue_dead_slot(eid)
+                    continue
+                fatal.append(eid)
             if self.supervisor is not None:
                 # Elastic path: the death is declared WITHOUT a fatal node
                 # error and handed to the supervisor; monitoring continues —
                 # further deaths (including the replacement's) re-enter here.
-                for eid in self._record_deaths(record_error=False):
+                for eid in fatal:
                     logger.warning("node %d stopped heartbeating (>%.0fs); "
                                    "scheduling supervised restart",
                                    eid, self._dead_after)
@@ -416,28 +546,16 @@ class TPUCluster:
                     # call_timeout, and without it the task would stay pinned
                     # (and every surviving worker spin-waiting on it) for the
                     # full ~11-minute socket budget; the worker's own later
-                    # requeue is then a safe no-op.
-                    entry = self._active_ledger.get(eid)
-                    if entry is not None:
-                        entry[0].requeue(entry[1])
-                        n = entry[0].requeue_unconsumed(entry[1])
-                        if n:
-                            logger.warning("re-delivering %d buffered "
-                                           "partition(s) node %d died holding",
-                                           n, eid)
-                    # Tear the dead slot's cached data client down NOW: a
-                    # feed worker blocked inside it (a dead ring peer sends
-                    # no RST) would otherwise ride out the full call_timeout
-                    # (~11 min) before noticing, and the worker's own
-                    # _drop_client on that error path is a safe no-op.
-                    self._drop_client(eid, abort=True)
+                    # requeue is then a safe no-op.  The client teardown
+                    # matters for the same reason: a worker blocked inside a
+                    # dead ring peer (no RST) is woken instead of waited on.
+                    self._requeue_dead_slot(eid)
                     self.supervisor.handle_death(eid)
                 continue
-            dead_data = self._record_deaths()
-            if dead_data:
+            if fatal:
                 logger.error("nodes %s stopped heartbeating (>%.0fs); failing "
                              "in-flight work and signalling stop",
-                             dead_data, self._dead_after)
+                             fatal, self._dead_after)
                 self.coordinator.signal_stop()
                 return
 
@@ -590,8 +708,10 @@ class TPUCluster:
         last_progress = time.monotonic()
         untracked_since: float | None = None
         while ledger.needs_drain(worker_pos):
-            if (self._shutdown_done
-                    or self.supervisor.permanently_failed(executor_id) is not None):
+            if self._shutdown_done or (
+                    self.supervisor is not None
+                    and self.supervisor.permanently_failed(executor_id)
+                    is not None):
                 return client
             # Checked EVERY iteration (the poll below may fail forever
             # against an exited process): a slot that stays untracked with
@@ -599,7 +719,8 @@ class TPUCluster:
             # its consumer chose to exit with the tail buffered, which
             # forfeits it exactly like a 'terminating' answer would.
             _, tracked = self.coordinator.registered_incarnation(executor_id)
-            if tracked or self.supervisor.restarting(executor_id):
+            if tracked or (self.supervisor is not None
+                           and self.supervisor.restarting(executor_id)):
                 untracked_since = None
             elif untracked_since is None:
                 untracked_since = time.monotonic()
@@ -707,9 +828,13 @@ class TPUCluster:
         views = [dataset if shuffle_seed is None
                  else dataset.shuffle_partitions(shuffle_seed + epoch)
                  for epoch in range(num_epochs)]
-        ledger = _PartitionLedger(dataset.num_partitions, num_epochs,
-                                  len(self._feed_ids),
-                                  max_attempts=self._max_feed_attempts)
+        # NOTE: the feedable-slot snapshot, the ledger, and the live-session
+        # install all commit TOGETHER under _train_lock just before the
+        # workers spawn (same lock _scale_in commits retirement intent
+        # under) — the closures below bind the ``ledger``/``feed_ids``
+        # names late, so defining them first is safe.  A snapshot taken
+        # out here instead would race a concurrent scale-in: the victim
+        # would get a fresh ledger slot feeding straight into its teardown.
         self._train_gen += 1
         train_gen = self._train_gen
         errors: list[Exception] = []
@@ -727,7 +852,15 @@ class TPUCluster:
                     # gone.  Poll the node's watermark until the window
                     # drains; if the node dies instead, the monitor requeues
                     # the window and next_task hands it back out here.
-                    if self.supervisor is None or not ledger.needs_drain(worker_pos):
+                    # A RETIRED slot must drain its watermark even without a
+                    # supervisor: scale-in's wait loop polls needs_drain, and
+                    # nobody else reads the node's consumed count once this
+                    # worker walks away — without this, a resize() on a
+                    # non-elastic cluster burns its whole drain_timeout and
+                    # then terminates a perfectly healthy victim.
+                    if not ledger.needs_drain(worker_pos) or (
+                            self.supervisor is None
+                            and not ledger.slot_retired(worker_pos)):
                         return
                     client = self._drain_slot_tail(ledger, worker_pos,
                                                    executor_id, qname, client)
@@ -775,6 +908,19 @@ class TPUCluster:
                     # ride out the slot's restart window; a surviving peer may
                     # pick the orphan up meanwhile.
                     ledger.requeue(worker_pos)
+                    if (ledger.slot_retired(worker_pos)
+                            or executor_id in self._retiring):
+                        # resize owns this slot's teardown: a feed failing
+                        # against a victim reaped mid-drain is part of the
+                        # plan, not a train() failure — the partition is
+                        # already requeued for survivors, so just walk away
+                        # (no restart is ever coming for a retired slot).
+                        logger.info(
+                            "feed worker for retiring node %d exiting; "
+                            "partition %d requeued for survivors",
+                            executor_id, p)
+                        self._drop_client(executor_id)
+                        return
                     inc_failed = self._client_incs.get(executor_id)
                     self._drop_client(executor_id)
                     client = None
@@ -820,23 +966,55 @@ class TPUCluster:
                 errors.append(wrapped)
                 ledger.fail(wrapped)
 
-        threads = [
-            threading.Thread(target=_runner, args=(pos, eid), name=f"feed-{eid}")
-            for pos, eid in enumerate(self._feed_ids)
-        ]
+        # Live train session: resize() scale-out attaches new feed workers
+        # through ``spawn`` while this call is in flight, so the thread list
+        # can GROW — the join loop below re-checks until it stabilizes.
+        session: dict = {"ledger": None, "threads": []}
+
+        def _spawn_worker(worker_pos: int, executor_id: int) -> None:
+            t = threading.Thread(target=_runner, args=(worker_pos, executor_id),
+                                 name=f"feed-{executor_id}")
+            session["threads"].append(t)
+            t.start()
+
+        session["spawn"] = _spawn_worker
         # The monitor re-delivers a dead slot's buffered-but-unconsumed
         # window the moment it declares the death — the slot's own feed
         # worker may be idle in next_task() at that point and would never
         # pass through the recovery path that also checks.
-        self._active_ledger = {eid: (ledger, pos)
-                               for pos, eid in enumerate(self._feed_ids)}
+        #
+        # Snapshot -> ledger -> install, all in ONE _train_lock hold:
+        # _scale_in commits retirement intent under this lock, so a
+        # concurrent scale-in either lands before the snapshot (victim
+        # excluded, retires with no slot here) or after the install
+        # (victim's slot found in _active_ledger and drained properly) —
+        # never in between, where it would EOF a slot this train is about
+        # to feed.  A slot mid-drain is excluded from the snapshot for the
+        # same reason.
+        with self._train_lock:
+            feed_ids = self._feedable_ids()
+            ledger = _PartitionLedger(dataset.num_partitions, num_epochs,
+                                      len(feed_ids),
+                                      max_attempts=self._max_feed_attempts)
+            session["ledger"] = ledger
+            self._train_session = session
+            self._active_ledger = {eid: (ledger, pos)
+                                   for pos, eid in enumerate(feed_ids)}
+            for pos, eid in enumerate(feed_ids):
+                _spawn_worker(pos, eid)
         try:
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            while True:
+                with self._train_lock:
+                    threads = list(session["threads"])
+                for t in threads:
+                    t.join()
+                with self._train_lock:
+                    if len(session["threads"]) == len(threads):
+                        break
         finally:
-            self._active_ledger = {}
+            with self._train_lock:
+                self._train_session = None
+                self._active_ledger = {}
         self._raise_node_errors()
         if errors:
             raise RuntimeError(f"feeding failed: {errors[0]}") from errors[0]
@@ -895,8 +1073,25 @@ class TPUCluster:
                 "InputMode.TENSORFLOW), whose feed carries shard paths for "
                 "node-side ingestion; for request/response scoring on a "
                 "DIRECT cluster use cluster.serve(export_dir) instead")
-        dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
-        num_workers = len(self._feed_ids)
+        # Snapshot: a concurrent resize() must not skew the worker/partition
+        # mapping mid-call (newcomers join the NEXT inference call, and a
+        # slot mid-drain must not be handed partitions it will never score).
+        # Atomic with the live-call marker: _scale_in checks the marker
+        # under the same lock before committing retirement intent, so a
+        # scale-in can never EOF a worker that owns statically-assigned
+        # partitions of THIS call — it refuses until the call completes
+        # (train() has a live re-feed session; inference() deliberately
+        # does not, its exactly-once contract is positional).
+        with self._train_lock:
+            feed_ids = self._feedable_ids()
+            self._inference_live += 1
+        try:
+            dataset = as_partitioned(data, default_partitions=len(feed_ids))
+        except Exception:
+            with self._train_lock:
+                self._inference_live -= 1
+            raise
+        num_workers = len(feed_ids)
         if eof_when_done:
             # Global-mesh scoring cannot be window-gated: a node whose next
             # partition is gated on earlier global output would stop feeding
@@ -984,10 +1179,25 @@ class TPUCluster:
         threads = [
             threading.Thread(target=_infer_worker, args=(pos, eid),
                              name=f"infer-{eid}", daemon=True)
-            for pos, eid in enumerate(self._feed_ids)
+            for pos, eid in enumerate(feed_ids)
         ]
-        for t in threads:
-            t.start()
+        started = 0
+        try:
+            for t in threads:
+                t.start()
+                started += 1
+        except Exception:
+            # partial start (thread exhaustion): stop the live workers and
+            # release the scale-in guard — a leaked _inference_live would
+            # refuse every scale-in for the cluster's remaining life
+            with cond:
+                state["stopped"] = True
+                cond.notify_all()
+            for t in threads[:started]:
+                t.join(timeout=10.0)
+            with self._train_lock:
+                self._inference_live -= 1
+            raise
         try:
             for p in range(dataset.num_partitions):
                 with cond:
@@ -1009,6 +1219,8 @@ class TPUCluster:
                 cond.notify_all()
             for t in threads:
                 t.join()
+            with self._train_lock:
+                self._inference_live -= 1
         self._raise_node_errors()
         if errors:
             # A worker that failed AFTER its last partition was collected
@@ -1044,6 +1256,385 @@ class TPUCluster:
         self._gateways.append(gateway)
         return gateway
 
+    # -- elastic autoscaling (beyond-reference: cluster.resize) ---------------
+
+    def _feedable_ids(self) -> list[int]:
+        """The ONE definition of 'feedable right now': data slots minus
+        those mid-drain (train()/inference() snapshots and the autoscaler's
+        ``current`` must never disagree on membership)."""
+        return [eid for eid in self._feed_ids if eid not in self._retiring]
+
+    def num_feedable(self) -> int:
+        """Feedable (non-evaluator, non-retiring) nodes right now — the
+        ``current`` the autoscaler policies compare their desired count to."""
+        return len(self._feedable_ids())
+
+    def resize(self, num_nodes: int, *, drain_timeout: float | None = None) -> dict:
+        """Grow or shrink the LIVE cluster to ``num_nodes`` feedable nodes.
+
+        **Scale-out** spawns fresh node processes through the launcher
+        (cloned from an existing worker's config), admits them through the
+        coordinator's rendezvous mid-run, and puts them to work immediately:
+        an in-flight ``train()`` gets a new feed worker whose ledger slot is
+        rebalanced a fair share of the still-queued partitions (plus the
+        shared orphan pool), and every open serving gateway admits the node
+        as a routing replica.
+
+        **Scale-in** picks the least-loaded victims (router outstanding,
+        then ``feed.queue_depth``; the chief — executor 0 — never retires),
+        marks them DRAINING (no new ledger assignments, serving routers stop
+        routing to them and drain their in-flight batches), waits for
+        buffered partitions to be consumed (``drain_timeout``, default
+        ``TOS_DRAIN_TIMEOUT``), sends end-of-feed so the map_fun exits
+        cleanly, and retires the slot *intentionally*: no respawn, no
+        restart-budget charge, no node error.  A victim killed mid-drain
+        cannot wedge the resize — the at-least-once ledger re-feeds its
+        partitions to survivors and the reaper escalates to terminate.
+
+        The reference cluster was frozen at ``num_executors`` for life
+        (Spark could replace a dead executor, never follow traffic); this is
+        the mechanism half of elastic autoscaling — drive it by hand, or let
+        :meth:`autoscale` run a telemetry-driven policy loop over it.
+        Refused for ``jax.distributed`` jobs (a live XLA world has a fixed
+        process count).  Returns a record of what changed (also appended to
+        the run report's ``autoscale`` block).
+
+        Collectives caveat: default-group ``ctx.barrier()``/reduces track
+        the live membership (retired slots leave the participant count),
+        but ``group="data"`` collectives and ``ctx.all_done`` consensus use
+        each node's registration-time ``num_data_nodes`` and do NOT follow
+        resizes yet — the ROADMAP's cross-host-collectives item owns the
+        generation-barrier rejoin design for SPMD workloads.
+        """
+        if num_nodes < 1:
+            raise ValueError("resize needs num_nodes >= 1")
+        if any(getattr(cfg, "jax_distributed", False)
+               for cfg in getattr(self.launcher, "configs", [])):
+            raise RuntimeError(
+                "cannot resize a jax.distributed job: a live XLA world has "
+                "a fixed process count (same constraint as elastic=True)")
+        with self._resize_lock:
+            if self._closing.is_set() or self._shutdown_done:
+                raise RuntimeError("cluster is shutting down")
+            current = self.num_feedable()
+            t0 = time.monotonic()
+            if num_nodes == current:
+                return {"action": "noop", "from": current, "to": current}
+            if num_nodes > current:
+                added = self._scale_out(num_nodes - current)
+                record: dict = {"action": "scale_out", "from": current,
+                                "to": current + len(added), "added": added}
+            else:
+                retired = self._scale_in(current - num_nodes, drain_timeout)
+                record = {"action": "scale_in", "from": current,
+                          "to": current - len(retired), "retired": retired}
+            record["secs"] = round(time.monotonic() - t0, 3)
+            self._resize_log.append(record)
+            telemetry.counter(f"cluster.{record['action']}_total").inc()
+            telemetry.gauge("cluster.feedable_nodes").set(self.num_feedable())
+            logger.info("cluster resized: %s", record)
+            return dict(record)
+
+    def _worker_template(self):
+        """The NodeConfig to clone for scale-out newcomers: the highest-
+        launch-index feedable node's — a worker wherever one exists (the
+        chief's config is only used on a 1-node cluster, where it is the
+        worker config too)."""
+        best = None
+        for meta in self.cluster_info:
+            if meta["executor_id"] not in self._feed_ids:
+                continue
+            li = meta.get("launch_index", -1)
+            if 0 <= li < len(self.launcher.configs) and (
+                    best is None or li > best):
+                best = li
+        if best is None:
+            raise RuntimeError("no feedable node config to clone for scale-out")
+        return self.launcher.configs[best]
+
+    def _scale_out(self, count: int) -> list[int]:
+        import dataclasses as _dc
+
+        template = self._worker_template()
+        new_ids = self.coordinator.open_slots(count)
+        base = len(self.launcher.processes)
+        configs = [_dc.replace(template, launch_index=base + j,
+                               replace_executor_id=-1)
+                   for j in range(count)]
+        timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
+        try:
+            self.launcher.spawn_more(configs)
+            ttrace.event("scale_out_spawn", executors=new_ids)
+            self.coordinator.await_slots(new_ids, timeout)
+        except Exception:
+            # reap what never registered: an unjoined newcomer must not
+            # linger half-booted, and its exit code is not the job's
+            # verdict.  A spawn_more failure lands here too (possibly with
+            # fewer than count processes appended), so guard the indexing.
+            procs = self.launcher.processes
+            for j in range(count):
+                if base + j >= len(procs):
+                    break
+                proc = procs[base + j]
+                with contextlib.suppress(Exception):
+                    if proc.is_alive():
+                        proc.terminate()
+                self._audit_waived.add(base + j)
+            # roll back membership so a LATER resize starts aligned:
+            # cancel_slots atomically retires any slot that managed to
+            # register before the timeout (it was just reaped — no error,
+            # id never reused) and cancels the never-registered rest, so
+            # open_slots' promised ids match registration order again and
+            # no ghost inflates the default barrier/reduce count
+            self.coordinator.cancel_slots(new_ids)
+            raise
+        self.cluster_info = self.coordinator.cluster_info()
+        for eid in new_ids:
+            self._feed_ids.append(eid)
+            self._attach_train_slot(eid)
+            for gw in self._gateways:
+                gw.add_replica(eid)
+            ttrace.event("scale_out", executor=eid)
+        return new_ids
+
+    def _attach_train_slot(self, executor_id: int) -> bool:
+        """Put a scale-out newcomer to work on an in-flight ``train()``:
+        add a ledger slot, rebalance queued partitions onto it, and start
+        its feed worker.  No-op (False) when no train is live."""
+        with self._train_lock:
+            session = self._train_session
+            if session is None or executor_id in self._active_ledger:
+                return False
+            ledger = session["ledger"]
+            pos = ledger.add_slot()
+            moved = ledger.rebalance_to(pos)
+            self._active_ledger[executor_id] = (ledger, pos)
+            session["spawn"](pos, executor_id)
+        logger.info("executor %d joined the live feed (slot %d, %d queued "
+                    "partition(s) rebalanced to it)", executor_id, pos, moved)
+        return True
+
+    def _pick_victims(self, count: int) -> list[int]:
+        """Least-loaded victim selection: serving-router outstanding first
+        (``replica_loads`` — the same numbers routing picks by), then
+        ``feed.queue_depth`` from the rolling stats, ties broken newest-
+        first.  The chief (executor 0) never retires — its process carries
+        cluster-level duties (TensorBoard, the reference's master role)."""
+        candidates = [eid for eid in self._feed_ids
+                      if eid != 0 and eid not in self._retiring]
+        if len(candidates) < count:
+            raise ValueError(
+                f"cannot retire {count} node(s): only {len(candidates)} "
+                "retireable (the chief never retires)")
+        loads: dict[int, float] = {eid: 0.0 for eid in candidates}
+        for gw in self._gateways:
+            for eid, n in gw.replica_loads().items():
+                if eid in loads:
+                    loads[eid] += n
+        try:
+            stats = self.coordinator.cluster_stats(5.0)
+            fq = (stats.get("serving") or {}).get("feed_queue_depth") or {}
+        except Exception:  # noqa: BLE001 - stats are advisory here
+            fq = {}
+        return sorted(candidates,
+                      key=lambda eid: (loads[eid], fq.get(str(eid)) or 0,
+                                       -eid))[:count]
+
+    def _proc_for(self, executor_id: int):
+        """(launch_index, process handle) for a slot, via the registered
+        launch_index (pids cannot map over ssh transports)."""
+        meta = next((m for m in self.cluster_info
+                     if m["executor_id"] == executor_id), None)
+        li = (meta or {}).get("launch_index", -1)
+        procs = self.launcher.processes
+        if 0 <= li < len(procs):
+            return li, procs[li]
+        return li, None
+
+    def _send_eof_best_effort(self, executor_id: int, qname: str,
+                              proc=None) -> None:
+        """Best-effort end-of-feed to one node queue — the teardown
+        protocol shared by ``shutdown()`` and scale-in retirement: one
+        short dial on the pooled client, then one retry on a FRESH
+        one-shot socket client, warning only on final failure.
+
+        One-attempt dials throughout: the default 3x60s backoff would
+        stack ~185s per queue against a blackholed host, all outside the
+        caller's timeout budget.  The retry client skips shm-ring
+        negotiation — no ring handshake just to deliver a ~20-byte EOF
+        frame.  A node whose process already exited is a normal teardown
+        race (its map_fun finished and closed its data plane first), not
+        a failure."""
+        try:
+            self._client(executor_id, connect_timeout=5.0,
+                         connect_attempts=1).send_eof(qname)
+            return
+        except Exception:  # noqa: BLE001 - retried on a fresh socket below
+            if proc is not None and not proc.is_alive():
+                logger.debug("node %d exited before EOF on %r",
+                             executor_id, qname)
+                return
+            # The cached client's socket may have died with an earlier
+            # timed-out call; this EOF is what unblocks the node's
+            # next_batch, so retry once on a FRESH connection before
+            # giving up.
+            self._drop_client(executor_id)
+            try:
+                meta = self._fresh_meta(executor_id)
+                retry = DataClient(meta["host"], meta["data_port"],
+                                   self.authkey, prefer_ring=False,
+                                   call_timeout=30.0, stall_timeout=30.0,
+                                   connect_timeout=5.0, connect_attempts=1)
+                try:
+                    retry.send_eof(qname)
+                finally:
+                    with contextlib.suppress(Exception):
+                        retry.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                logger.warning("could not send EOF to node %d queue %r",
+                               executor_id, qname, exc_info=True)
+
+    def _send_retirement_eof(self, executor_id: int) -> None:
+        """End-of-feed to one retiring node so its map_fun exits cleanly
+        (FIFO: everything already buffered is consumed first).  Best-effort
+        — a node that died mid-drain gets reaped by the caller instead."""
+        _, proc = self._proc_for(executor_id)
+        for qname in self.input_qnames:
+            self._send_eof_best_effort(executor_id, qname, proc=proc)
+
+    def _scale_in(self, count: int, drain_timeout: float | None) -> list[int]:
+        if drain_timeout is None:
+            drain_timeout = _env_float("TOS_DRAIN_TIMEOUT", 60.0)
+        victims = self._pick_victims(count)
+        # Intent FIRST: from this moment a victim's death is retirement —
+        # the supervisor declines recovery, the monitor requeues without
+        # escalation, and no restart budget is charged.  Committed under
+        # _train_lock against the live-inference marker: an inference()
+        # call's partitions are statically assigned to the workers that
+        # started it, so a retirement EOF mid-call would fail the whole
+        # call on a healthy cluster — refuse instead (the autoscaler's
+        # next tick simply retries).
+        with self._train_lock:
+            if self._inference_live:
+                raise RuntimeError(
+                    "cannot scale in during a live inference() call: its "
+                    "partitions are statically assigned to the workers "
+                    "that started it; retry after the call completes")
+            for eid in victims:
+                self._retiring.add(eid)
+        for eid in victims:
+            if self.supervisor is not None:
+                self.supervisor.retire(eid)
+        self.coordinator.mark_draining(victims)
+        ttrace.event("drain_begin", executors=victims)
+        # TOS_DRAIN_TIMEOUT is a PER-VICTIM budget (the knob's contract),
+        # not a shared pot: every victim has been draining concurrently
+        # since intent was marked above, so a loaded early victim consuming
+        # its full budget must not starve the later ones into forced
+        # terminates — each blocking step below gets the full allowance.
+        # 1) Serving: drain each victim out of every gateway's routing
+        #    (in-flight batches finish; queued ones re-route on timeout).
+        for gw in self._gateways:
+            for eid in victims:
+                with contextlib.suppress(Exception):
+                    gw.retire_replica(eid, timeout=max(1.0, drain_timeout))
+        # 2) Training ledger: queued home partitions to the orphan pool,
+        #    then wait for the in-flight feed and the buffered-but-
+        #    unconsumed window to drain (watermark path).  A victim that
+        #    dies here breaks the wait via is_tracked — the monitor already
+        #    requeued its window.
+        with self._train_lock:
+            entries = [(eid, self._active_ledger.get(eid)) for eid in victims]
+        for eid, entry in entries:
+            if entry is not None:
+                moved = entry[0].retire_slot(entry[1])
+                if moved:
+                    logger.info("%d queued partition(s) of retiring node %d "
+                                "redistributed", moved, eid)
+        for eid, entry in entries:
+            if entry is None:
+                continue
+            ledger, pos = entry
+            victim_deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < victim_deadline:
+                if ledger.slot_idle(pos) and not ledger.needs_drain(pos):
+                    break
+                if not self.coordinator.is_tracked(eid):
+                    break  # died/exited; the ledger re-feed owns its work
+                if self._closing.is_set():
+                    break  # shutdown owns teardown from here; stop waiting
+                time.sleep(0.1)
+        # 3) Retirement EOF -> map_fun exits -> clean process exit.
+        for eid in victims:
+            if self.coordinator.is_tracked(eid):
+                self._send_retirement_eof(eid)
+        # 4) Reap: join the process (a fresh per-victim budget — the
+        #    knob's contract is per victim, and victims drained
+        #    concurrently since intent, so a loaded early victim must not
+        #    starve a later one into a forced terminate), escalating past
+        #    it; then finalize the slot's retirement everywhere.
+        for eid in victims:
+            li, proc = self._proc_for(eid)
+            if proc is not None:
+                proc.join(max(2.0, drain_timeout))
+                if proc.is_alive():
+                    logger.warning("retiring node %d did not exit after EOF; "
+                                   "terminating it", eid)
+                    # stop liveness tracking FIRST so the monitor never
+                    # flags the terminate as a death
+                    self.coordinator.forget([eid])
+                    proc.terminate()
+                    proc.join(5.0)
+                    if proc.is_alive():
+                        proc.kill()
+                        proc.join(5.0)
+            # Whatever ended the victim — clean EOF exit, our terminate,
+            # or a kill that landed too close to the reap for the monitor
+            # to declare (retire_node below forecloses that declaration
+            # for good) — put its in-flight + buffered-but-unconsumed
+            # ledger window back in play NOW.  Idempotent: a fully-drained
+            # window requeues nothing, and at-least-once semantics demand
+            # re-feeding anything that cannot be PROVEN consumed.
+            self._requeue_dead_slot(eid)
+            if li >= 0:
+                # a retired node's exit code is not the job's verdict (we
+                # may have terminated it, or chaos killed it mid-drain)
+                self._audit_waived.add(li)
+            self._drop_client(eid, abort=True)
+            self.coordinator.retire_node(eid)
+            if self.supervisor is None:
+                telemetry.counter("elastic.retirements_total").inc()
+            if eid in self._feed_ids:
+                self._feed_ids.remove(eid)
+            self._retiring.discard(eid)
+            ttrace.event("scale_in", executor=eid)
+        return victims
+
+    def autoscale(self, policy=None, **kwargs):
+        """Start a telemetry-driven autoscaling loop over :meth:`resize`:
+        each tick samples ``cluster.stats(window)``, asks the policy for a
+        desired node count, applies hysteresis (cooldown after any action;
+        scale-in only after K consecutive under-target windows) and min/max
+        bounds, and resizes.  Returns the started
+        :class:`~tensorflowonspark_tpu.autoscale.Autoscaler` (stopped
+        automatically at shutdown), or None when disabled via
+        ``TOS_AUTOSCALE=0`` — the ops kill switch.
+
+        Keyword args (``min_nodes``, ``max_nodes``, ``tick_secs``,
+        ``cooldown_secs``, ``scale_in_ticks``, ``window``, ...) pass through
+        to ``Autoscaler``; the ``TOS_AUTOSCALE_*`` knobs supply defaults.
+        """
+        if not _env_bool("TOS_AUTOSCALE", True):
+            logger.warning("autoscaling disabled by TOS_AUTOSCALE=0; "
+                           "cluster.autoscale() is a no-op")
+            return None
+        from tensorflowonspark_tpu.autoscale import Autoscaler
+
+        scaler = Autoscaler(self, policy, **kwargs)
+        scaler.start()
+        self._autoscalers.append(scaler)
+        return scaler
+
     # -- teardown (reference TFCluster.shutdown :~170-240, §3.5) -------------
 
     def shutdown(self, grace_secs: float = 0.0, timeout: float | None = None) -> None:
@@ -1057,6 +1648,19 @@ class TPUCluster:
             timeout = _env_float("TOS_SHUTDOWN_TIMEOUT", 120.0)
         if self._shutdown_done:
             return
+        # Autoscalers first: a policy loop firing resize() mid-teardown
+        # would race the EOF/join sequence below.  _closing makes any
+        # FUTURE resize() refuse and tells an in-flight drain to stop
+        # waiting; the bare lock acquisition then barriers on that
+        # in-flight resize actually releasing _feed_ids before teardown
+        # iterates it (scaler.stop's 30s join alone could give up while a
+        # long drain still holds the lock).
+        self._closing.set()
+        for scaler in self._autoscalers:
+            with contextlib.suppress(Exception):
+                scaler.stop()
+        with self._resize_lock:
+            pass
         # Stop the dead-node monitor first: shutdown's own escalation
         # (join -> stop -> terminate) owns failure handling from here, and
         # nodes it terminates must not be re-reported as deaths.  The
@@ -1095,54 +1699,8 @@ class TPUCluster:
                                  executor_id)
                     continue
                 for qname in self.input_qnames:
-                    try:
-                        # Teardown dial: one short attempt (the capped
-                        # retry below handles the rest) — the default
-                        # 3x60s backoff dial would stack ~185s per queue
-                        # against a blackholed host, all outside the
-                        # shutdown timeout budget.
-                        self._client(executor_id, connect_timeout=5.0,
-                                     connect_attempts=1).send_eof(qname)
-                    except Exception:
-                        proc = id_to_proc.get(executor_id)
-                        if proc is not None and not proc.is_alive():
-                            # Normal teardown race: the node finished its
-                            # map_fun (e.g. inference loops exit on stop)
-                            # and closed its data plane before EOF landed.
-                            logger.debug("node %d exited before EOF on %r",
-                                         executor_id, qname)
-                            continue
-                        # The cached client's socket may have died with an
-                        # earlier timed-out call; this EOF is what unblocks
-                        # the node's next_batch, so retry once on a FRESH
-                        # connection before giving up.  One-shot socket
-                        # client: no shm-ring negotiation just to deliver
-                        # a ~20-byte EOF frame during teardown.
-                        stale = self._clients.pop(executor_id, None)
-                        if stale is not None:
-                            with contextlib.suppress(Exception):
-                                stale.close()
-                        try:
-                            meta = self._fresh_meta(executor_id)
-                            # One short dial only: teardown against an
-                            # unreachable host must not stack the default
-                            # 3-attempt backoff (~3x60s) outside the
-                            # shutdown timeout budget.
-                            retry = DataClient(meta["host"], meta["data_port"],
-                                               self.authkey, prefer_ring=False,
-                                               call_timeout=30.0,
-                                               stall_timeout=30.0,
-                                               connect_timeout=5.0,
-                                               connect_attempts=1)
-                            try:
-                                retry.send_eof(qname)
-                            finally:
-                                with contextlib.suppress(Exception):
-                                    retry.close()
-                        except Exception:
-                            logger.warning(
-                                "could not send EOF to node %d queue %r",
-                                executor_id, qname, exc_info=True)
+                    self._send_eof_best_effort(
+                        executor_id, qname, proc=id_to_proc.get(executor_id))
             if grace_secs:
                 time.sleep(grace_secs)
             # Politely wait for map_funs to finish; only then escalate.  The
@@ -1206,11 +1764,16 @@ class TPUCluster:
             except Exception:  # noqa: BLE001 - reporting must not mask errors
                 logger.warning("could not write run report", exc_info=True)
             self._raise_node_errors()
-            exit_codes = [p.exitcode for p in self.launcher.processes]
-            if any(code is None for code in exit_codes):
+            all_codes = [p.exitcode for p in self.launcher.processes]
+            if any(code is None for code in all_codes):
                 # survived SIGTERM+SIGKILL: a live zombie may still hold chips
-                raise RuntimeError(f"node processes could not be killed (exit codes {exit_codes}); "
+                raise RuntimeError(f"node processes could not be killed (exit codes {all_codes}); "
                                    f"zombie processes may be holding TPU devices")
+            # intentionally-retired slots (resize scale-in) are excluded
+            # from the audit: their terminate/kill-mid-drain exit codes are
+            # the resize's business, not the job's verdict
+            exit_codes = [c for i, c in enumerate(all_codes)
+                          if i not in self._audit_waived]
             if forced:
                 raise RuntimeError(f"node processes had to be force-terminated (exit codes {exit_codes})")
             if any(code != 0 for code in exit_codes):
@@ -1341,6 +1904,20 @@ class TPUCluster:
                  if self.supervisor.restart_count(eid)}
                 if self.supervisor is not None else {}),
         }
+        if self._resize_log or self._autoscalers:
+            # the elasticity postmortem: every resize the run performed and
+            # (when a policy loop drove them) every decision it took
+            autoscale_block: dict = {
+                "final_nodes": self.num_feedable(),
+                "resizes": [dict(r) for r in self._resize_log],
+            }
+            for scaler in self._autoscalers:
+                try:
+                    autoscale_block.setdefault("policies", []).append(
+                        scaler.report())
+                except Exception:  # noqa: BLE001 - reporting must not mask the run
+                    logger.debug("autoscaler report failed", exc_info=True)
+            extras["autoscale"] = autoscale_block
         try:
             # flight-recorder timeline: every process's structured events
             # (kills, deaths, retries, resyncs, reloads) merged onto the

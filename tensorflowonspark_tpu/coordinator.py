@@ -194,6 +194,13 @@ class CoordinatorServer:
         # not its life — is rejected, so a restarted replacement can never
         # race its predecessor on heartbeats, barriers, or reduces.
         self._incarnations: dict[int, int] = {}
+        # Elastic membership (cluster.resize): slots being deliberately
+        # drained out of service (no new work; death mid-drain finalizes the
+        # retirement instead of triggering recovery) and slots already
+        # retired for good (their executor_id is never reused — SPMD-style
+        # positional identity stays stable across the cluster's lifetime).
+        self._draining: set[int] = set()
+        self._retired: set[int] = set()
         # Telemetry store: the latest raw registry snapshot per executor,
         # merged key-by-key from the compact deltas nodes piggyback on
         # heartbeats (and the final snapshot sent with deregister).  Values
@@ -424,6 +431,123 @@ class CoordinatorServer:
             return (self._incarnations.get(executor_id, 0),
                     executor_id in self._last_seen)
 
+    # -- elastic membership (cluster.resize) ---------------------------------
+
+    def open_slots(self, count: int, job_name: str = "worker") -> list[int]:
+        """Admit ``count`` NEW executor slots mid-run (scale-out): extend the
+        role template and raise ``expected`` so the next ``count``
+        registrations are assigned the fresh ids.  Returns the executor ids
+        the newcomers will receive (registration order).  The initial
+        formation barrier (``await_registrations``) is unaffected — it
+        completed long ago; latecomers join a cluster that is already live.
+        """
+        if count < 1:
+            raise ValueError("open_slots needs count >= 1")
+        with self._lock:
+            if not self._complete.is_set():
+                raise RuntimeError("cannot open slots before the cluster formed")
+            next_task = 1 + max(
+                (t for name, t in self.roles if name == job_name), default=-1)
+            new_ids = list(range(len(self.roles), len(self.roles) + count))
+            self.roles.extend((job_name, next_task + i) for i in range(count))
+            self.expected += count
+        logger.info("opened %d new executor slot(s): ids %s", count, new_ids)
+        return new_ids
+
+    def await_slots(self, executor_ids: list[int], timeout: float) -> None:
+        """Block until every listed slot has registered (scale-out join)."""
+        deadline = time.monotonic() + timeout
+        pending = set(executor_ids)
+        while True:
+            with self._lock:
+                have = {m["executor_id"] for m in self._nodes}
+            pending -= have
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"new node slot(s) {sorted(pending)} did not register "
+                    f"within {timeout}s")
+            time.sleep(0.1)
+
+    def cancel_slots(self, executor_ids: list[int]) -> None:
+        """Roll back :meth:`open_slots` for slots that never registered (a
+        scale-out that timed out): pop the unfilled tail roles and lower
+        ``expected`` so the NEXT scale-out's promised ids line up with
+        registration order again.  ``_op_register`` assigns
+        ``executor_id = len(_nodes)`` while ``open_slots`` promises ids from
+        ``len(roles)`` — without this rollback one failed scale-out leaves
+        them desynchronized forever (every later ``await_slots`` waits on
+        ids no registration can ever be assigned).  Slots that DID register
+        before the timeout are RETIRED in the same lock hold — doing the
+        registered-check driver-side would race a register RPC landing in
+        between, leaving a ghost that every default-count barrier/reduce
+        waits on forever."""
+        retired: list[int] = []
+        with self._lock:
+            taken = {m["executor_id"] for m in self._nodes}
+            # ids are assigned in registration order, so the unregistered
+            # promised slots are always the tail of the role table
+            for eid in sorted(executor_ids, reverse=True):
+                if eid in taken:
+                    live = self._retire_locked(eid)
+                    retired.append(eid)
+                    continue
+                if eid == len(self.roles) - 1:
+                    self.roles.pop()
+                    self.expected -= 1
+        if retired:
+            telemetry.gauge("coordinator.live_slots").set(live)
+        for eid in retired:
+            ttrace.event("retired", executor=eid)
+            logger.info("executor %d retired (failed scale-out reaped it)",
+                        eid)
+
+    def mark_draining(self, executor_ids: list[int]) -> None:
+        """Flag slots as DRAINING (scale-in in progress): still alive and
+        serving their in-flight work, but no new assignments — and a death
+        mid-drain finalizes the retirement instead of scheduling recovery."""
+        with self._lock:
+            self._draining.update(executor_ids)
+
+    def draining_nodes(self) -> list[int]:
+        with self._lock:
+            return sorted(self._draining)
+
+    def is_draining(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._draining
+
+    def _retire_locked(self, executor_id: int) -> int:
+        """State half of :meth:`retire_node` (caller holds ``_lock``);
+        returns the live-slot count for the gauge."""
+        self._last_seen.pop(executor_id, None)
+        self._incarnations[executor_id] = \
+            self._incarnations.get(executor_id, 0) + 1
+        self._draining.discard(executor_id)
+        self._retired.add(executor_id)
+        self._stats_history.pop(str(executor_id), None)
+        for m in self._nodes:
+            if m["executor_id"] == executor_id:
+                m["retired"] = True
+        return len(self._last_seen)
+
+    def retire_node(self, executor_id: int) -> None:
+        """Finalize an INTENTIONAL retirement (scale-in): stop liveness
+        tracking with no error recorded, fence the incarnation so any
+        straggler process is rejected, flag the slot meta ``retired`` (the
+        executor_id is never reused), and drop the slot's rolling-stats
+        stream so dashboards stop averaging a ghost."""
+        with self._lock:
+            live = self._retire_locked(executor_id)
+        telemetry.gauge("coordinator.live_slots").set(live)
+        ttrace.event("retired", executor=executor_id)
+        logger.info("executor %d retired (intentional scale-in)", executor_id)
+
+    def is_retired(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._retired
+
     # -- telemetry (cluster metrics transport) -------------------------------
 
     def _merge_metrics_locked(self, executor_id: int, payload: dict) -> None:
@@ -566,6 +690,11 @@ class CoordinatorServer:
                 "serve.inflight_batches"),
             "replicas_healthy": (driver.get("gauges") or {}).get(
                 "serve.replicas_healthy"),
+            # "shrinking on purpose" vs "losing replicas": draining replicas
+            # are a deliberate scale-in in progress, not a failure signal
+            "replicas_draining": (driver.get("gauges") or {}).get(
+                "serve.replicas_draining"),
+            "draining_nodes": self.draining_nodes(),
             "feed_queue_depth": {
                 key: (s.get("gauges") or {}).get("feed.queue_depth")
                 for key, s in out["streams"].items() if key != "driver"},
@@ -732,7 +861,8 @@ class CoordinatorServer:
         if replace is not None:
             return self._op_register_replacement(int(replace), meta)
         with self._lock:
-            if self._complete.is_set():
+            if len(self._nodes) >= self.expected:
+                # complete AND no opened scale-out slots outstanding
                 return {"ok": False, "error": "cluster already complete"}
             executor_id = len(self._nodes)
             job_name, task_index = self.roles[executor_id]
@@ -763,6 +893,13 @@ class CoordinatorServer:
             slot = next((m for m in self._nodes if m["executor_id"] == executor_id), None)
             if slot is None:
                 return {"ok": False, "error": f"no executor slot {executor_id} to replace"}
+            if executor_id in self._retired:
+                # a supervised respawn racing retire_node: the slot was
+                # scaled in while the replacement booted — admitting it
+                # would resurrect a ghost member nobody feeds or retires
+                return {"ok": False, "error": (f"executor slot {executor_id} "
+                                               "was retired (scale-in); "
+                                               "refusing replacement")}
             if executor_id in self._last_seen:
                 return {"ok": False, "error": (f"executor {executor_id} is still "
                                                "liveness-tracked; refusing replacement")}
@@ -785,8 +922,14 @@ class CoordinatorServer:
         timeout = msg.get("timeout", 300.0)
         # Participant count may be a subgroup (e.g. feedable nodes excluding
         # the evaluator); every participant must pass the same count.
-        count = int(msg.get("count") or self.expected)
+        count = msg.get("count")
         with self._lock:
+            if not count:
+                # Default = LIVE membership: expected only ever grows, and
+                # retired slots (scale-in) are gone for good — a barrier at
+                # the pre-resize count would wait on ghosts forever.
+                count = self.expected - len(self._retired)
+            count = int(count)
             rdv = self._rdv.get(name)
             # done/aborted generations are popped by whoever finished them,
             # but guard anyway: never join a finished generation.

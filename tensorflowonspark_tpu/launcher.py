@@ -84,6 +84,28 @@ class _RespawnMixin:
         old.join(5.0)
         self._procs[index] = self._spawn_one(index, config or self._configs[index])
 
+    def spawn_more(self, configs: Sequence["NodeConfig"]) -> None:
+        """Append fresh node processes to a LIVE launch (cluster.resize
+        scale-out): each config's ``launch_index`` must equal its position
+        in the extended process list — the registration-time key the driver
+        uses to map executor ids back to process handles."""
+        for offset, config in enumerate(configs):
+            expect = len(self._procs) + offset
+            if config.launch_index != expect:
+                raise ValueError(
+                    f"spawn_more config at position {offset} has "
+                    f"launch_index {config.launch_index}, expected {expect}")
+        for config in configs:
+            self._configs.append(config)
+            try:
+                self._procs.append(self._spawn_one(config.launch_index, config))
+            except Exception:
+                # keep _configs and _procs the same length: a later
+                # spawn_more validates launch_index against len(_procs),
+                # and a dangling config would desynchronize them for good
+                self._configs.pop()
+                raise
+
 
 class LocalLauncher(_RespawnMixin):
     """Spawn node processes on the local host.
@@ -411,6 +433,14 @@ class TPUPodLauncher(_RespawnMixin):
         raise NotImplementedError(
             "TPUPodLauncher cannot respawn a single slot of a live "
             "jax.distributed pod; relaunch the whole pod instead")
+
+    def spawn_more(self, configs: Sequence[NodeConfig]) -> None:
+        """A pod's process count is fixed by its jax.distributed world size;
+        ``cluster.resize`` refuses distributed jobs up front — this guard
+        catches direct callers."""
+        raise NotImplementedError(
+            "TPUPodLauncher cannot grow a live jax.distributed pod; "
+            "relaunch the pod at the new size instead")
 
     @property
     def processes(self) -> list[PopenHandle]:

@@ -173,6 +173,24 @@ class ServingGateway:
     def healthy_replicas(self) -> list[int]:
         return self._router.healthy_replicas()
 
+    def replica_loads(self) -> dict[int, int]:
+        """Per-replica outstanding batches (the router's routing signal) —
+        what autoscaling victim selection reads."""
+        return self._router.replica_loads()
+
+    # -- elastic membership (driven by cluster.resize) -----------------------
+
+    def add_replica(self, executor_id: int) -> bool:
+        """Admit a freshly-registered serving node into this gateway's
+        routing (scale-out)."""
+        return self._router.add_replica(executor_id)
+
+    def retire_replica(self, executor_id: int, timeout: float = 60.0) -> bool:
+        """Drain one replica out of this gateway's routing (scale-in): stop
+        routing to it, let its in-flight batches finish (re-routing them to
+        survivors on timeout or death), then drop it."""
+        return self._router.retire_replica(executor_id, timeout)
+
     # -- hot reload ----------------------------------------------------------
 
     def reload(self) -> dict[int, Any]:

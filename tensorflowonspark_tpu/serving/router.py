@@ -54,7 +54,8 @@ logger = logging.getLogger(__name__)
 
 class _Replica:
     __slots__ = ("executor_id", "queue", "inflight", "healthy", "client",
-                 "client_inc", "pending_ctl", "thread", "last_pick")
+                 "client_inc", "pending_ctl", "thread", "last_pick",
+                 "draining", "retired")
 
     def __init__(self, executor_id: int):
         self.executor_id = executor_id
@@ -68,6 +69,18 @@ class _Replica:
         self.pending_ctl: dict | None = None
         self.thread: threading.Thread | None = None
         self.last_pick = 0
+        # scale-in lifecycle (retire_replica): a DRAINING replica finishes
+        # its queued/in-flight batches but is never picked for new ones;
+        # RETIRED tells its worker thread to exit once the queue is empty
+        self.draining = False
+        self.retired = False
+
+
+def _load(rep: _Replica) -> int:
+    """A replica's outstanding work (queued + in-flight batches) — the ONE
+    load definition shared by routing picks, the inflight gauge, the public
+    ``replica_loads()`` surface, and autoscaling victim selection."""
+    return len(rep.queue) + rep.inflight
 
 
 class ReplicaRouter:
@@ -93,8 +106,10 @@ class ReplicaRouter:
         self._replicas: dict[int, _Replica] = {
             eid: _Replica(eid) for eid in cluster._feed_ids}
         self._healthy_gauge = telemetry.gauge("serve.replicas_healthy")
+        self._draining_gauge = telemetry.gauge("serve.replicas_draining")
         self._outstanding_gauge = telemetry.gauge("serve.inflight_batches")
         self._healthy_gauge.set(len(self._replicas))
+        self._draining_gauge.set(0)
         for rep in self._replicas.values():
             rep.thread = threading.Thread(
                 target=self._worker, args=(rep,), daemon=True,
@@ -121,21 +136,20 @@ class ReplicaRouter:
 
     def _pick_locked(self, exclude: int | None) -> _Replica | None:
         live = [r for r in self._replicas.values()
-                if r.healthy and r.executor_id != exclude]
+                if r.healthy and not r.draining and r.executor_id != exclude]
         if not live:
             return None
         # least-outstanding, ties broken least-recently-picked: a fixed
         # tiebreak (executor id) would route EVERY batch to replica 0 at
         # low load, leaving the rest cold — LRU rotation spreads them
-        target = min(live, key=lambda r: (len(r.queue) + r.inflight,
-                                          r.last_pick))
+        target = min(live, key=lambda r: (_load(r), r.last_pick))
         self._pick_seq += 1
         target.last_pick = self._pick_seq
         return target
 
     def _update_outstanding_locked(self) -> None:
         self._outstanding_gauge.set(sum(
-            len(r.queue) + r.inflight for r in self._replicas.values()))
+            _load(r) for r in self._replicas.values()))
 
     def has_capacity(self) -> bool:
         """True while some healthy replica is strictly IDLE (0 outstanding).
@@ -150,23 +164,36 @@ class ReplicaRouter:
         With NO healthy replica it returns True so batches flush and fail
         fast instead of silently aging out on their deadlines."""
         with self._cond:
-            live = [r for r in self._replicas.values() if r.healthy]
+            live = [r for r in self._replicas.values()
+                    if r.healthy and not r.draining]
             if not live:
                 return True
-            return any(len(r.queue) + r.inflight == 0 for r in live)
+            return any(_load(r) == 0 for r in live)
 
     # -- per-replica worker --------------------------------------------------
 
     def _worker(self, rep: _Replica) -> None:
         while True:
+            exit_client = None
             with self._cond:
-                while not self._stop and not rep.queue:
+                while not self._stop and not rep.queue and not rep.retired:
                     self._cond.wait(0.2)
-                if self._stop:
-                    return
-                batch = rep.queue.pop(0)
-                rep.inflight += 1
-                self._update_outstanding_locked()
+                if self._stop or (rep.retired and not rep.queue):
+                    if rep.retired:
+                        # retire_replica leaves the client to us when we
+                        # outlived its join (batch completing past the
+                        # drain deadline); on stop, close() owns clients
+                        exit_client, rep.client = rep.client, None
+                    batch = None
+                else:
+                    batch = rep.queue.pop(0)
+                    rep.inflight += 1
+                    self._update_outstanding_locked()
+            if batch is None:
+                if exit_client is not None:
+                    with contextlib.suppress(Exception):
+                        exit_client.close()
+                return
             error: Exception | None = None
             results: list | None = None
             if batch.trace is not None and batch.retries == 0:
@@ -266,7 +293,11 @@ class ReplicaRouter:
             with self._cond:
                 if self._stop:
                     return
-                down = [r for r in self._replicas.values() if not r.healthy]
+                # draining replicas are on their way OUT (retire_replica owns
+                # their teardown) — re-admitting one would route new batches
+                # onto a node about to receive its EOF
+                down = [r for r in self._replicas.values()
+                        if not r.healthy and not r.draining]
             for rep in down:
                 self._try_recover(rep)
             with self._cond:
@@ -438,6 +469,97 @@ class ReplicaRouter:
         with self._cond:
             return sorted(r.executor_id for r in self._replicas.values()
                           if r.healthy)
+
+    def replica_loads(self) -> dict[int, int]:
+        """Outstanding (queued + in-flight) batches per replica — the same
+        numbers least-outstanding routing picks by, exposed for autoscaling
+        victim selection and ``cluster.stats()`` so the policy and the
+        router can never disagree on per-replica load."""
+        with self._cond:
+            return {r.executor_id: _load(r)
+                    for r in self._replicas.values()}
+
+    # -- elastic membership (cluster.resize) ---------------------------------
+
+    def add_replica(self, executor_id: int) -> bool:
+        """Admit a freshly-joined serving node into routing (scale-out).
+        Idempotent; returns True when a new replica was added."""
+        with self._cond:
+            if self._stop or executor_id in self._replicas:
+                return False
+            rep = self._replicas[executor_id] = _Replica(executor_id)
+            self._healthy_gauge.set(
+                sum(1 for r in self._replicas.values() if r.healthy))
+        rep.thread = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"serve-replica-{executor_id}")
+        rep.thread.start()
+        ttrace.event("replica_added", executor=executor_id)
+        logger.info("serving replica %d admitted into routing", executor_id)
+        return True
+
+    def retire_replica(self, executor_id: int, timeout: float = 60.0) -> bool:
+        """Drain one replica out of routing (scale-in): no new batches are
+        routed to it, its queued/in-flight batches finish normally, then it
+        is removed.  If the drain times out (or the replica dies mid-drain),
+        its never-attempted queued batches re-route to the survivors without
+        spending their retry.  Returns True when the drain completed clean,
+        False on timeout/forced reroute; idempotent for unknown ids."""
+        with self._cond:
+            rep = self._replicas.get(executor_id)
+            if rep is None:
+                return True
+            rep.draining = True
+            self._draining_gauge.set(
+                sum(1 for r in self._replicas.values() if r.draining))
+            self._cond.notify_all()
+        deadline = _monotonic() + timeout
+        leftovers: list[MicroBatch] = []
+        clean = True
+        with self._cond:
+            while _load(rep) and not self._stop:
+                if not rep.healthy:
+                    # died mid-drain: its worker already rerouted the queue
+                    # via _mark_unhealthy_locked; whatever is left is ours
+                    break
+                if _monotonic() >= deadline:
+                    clean = False
+                    break
+                self._cond.wait(0.2)
+            leftovers, rep.queue = rep.queue, []
+            rep.retired = True
+            self._replicas.pop(executor_id, None)
+            self._healthy_gauge.set(
+                sum(1 for r in self._replicas.values() if r.healthy))
+            self._draining_gauge.set(
+                sum(1 for r in self._replicas.values() if r.draining))
+            self._update_outstanding_locked()
+            self._cond.notify_all()
+        for batch in leftovers:
+            # never attempted on the retiring replica: re-route without
+            # spending the batch's one retry
+            self.submit(batch, exclude=executor_id)
+        if rep.thread is not None:
+            rep.thread.join(timeout=10.0)
+        # Close the client only once the worker has actually exited: a
+        # worker still blocked mid-``infer_round`` past the join (node
+        # compute longer than drain_timeout + 10s) is about to COMPLETE
+        # that batch — yanking its socket here would fail it and spend its
+        # one retry for nothing.  The worker's retired-exit path owns the
+        # teardown in that case.
+        with self._cond:
+            worker_live = rep.thread is not None and rep.thread.is_alive()
+            client, rep.client = (None, rep.client) if worker_live \
+                else (rep.client, None)
+        if client is not None:
+            with contextlib.suppress(Exception):
+                client.close()
+        ttrace.event("replica_retired", executor=executor_id,
+                     clean=clean and not leftovers)
+        logger.info("serving replica %d drained out of routing%s",
+                    executor_id,
+                    "" if clean else " (drain timed out; queue rerouted)")
+        return clean and not leftovers
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -85,6 +85,11 @@ class Supervisor:
         self._restarts: dict[int, int] = {}
         self._permanent: dict[int, str] = {}
         self._inflight: set[int] = set()
+        # Slots being retired ON PURPOSE (cluster.resize scale-in): their
+        # death — clean exit, or a kill mid-drain — is classified as
+        # retirement, never recovery: no respawn, no restart-budget charge,
+        # no elastic.restarts_total increment.
+        self._retired: set[int] = set()
         self._threads: list[threading.Thread] = []
 
     # -- status (consumed by the partition ledger's recovery waits) ----------
@@ -93,6 +98,19 @@ class Supervisor:
         """The recorded reason when the slot is beyond recovery, else None."""
         with self._lock:
             return self._permanent.get(executor_id)
+
+    def retire(self, executor_id: int) -> None:
+        """Mark the slot's upcoming death INTENTIONAL (scale-in drain has
+        begun): ``handle_death`` will decline to recover it.  Distinct from
+        a permanent failure — retirement records no node error and signals
+        no stop; the cluster simply got smaller on purpose."""
+        with self._lock:
+            self._retired.add(executor_id)
+        telemetry.counter("elastic.retirements_total").inc()
+
+    def retired(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._retired
 
     def restart_count(self, executor_id: int) -> int:
         with self._lock:
@@ -112,6 +130,12 @@ class Supervisor:
         if self._stopped.is_set():
             return
         with self._lock:
+            if executor_id in self._retired:
+                # intentional retirement (scale-in): the death IS the plan —
+                # no respawn, no budget charge, no restart counted
+                logger.info("executor %d died while retiring; not recovering "
+                            "(intentional scale-in)", executor_id)
+                return
             if executor_id in self._inflight or executor_id in self._permanent:
                 return
             self._inflight.add(executor_id)
@@ -171,6 +195,10 @@ class Supervisor:
             # tracking, so the monitor cannot re-detect it — the supervisor
             # itself must notice and spend the remaining budget on it.
             while True:
+                if self.retired(executor_id):
+                    # a resize retired this slot while recovery was pending:
+                    # the restart is no longer wanted
+                    return
                 attempt = self.restart_count(executor_id)
                 reason = self._classify(executor_id, attempt)
                 if reason is not None:

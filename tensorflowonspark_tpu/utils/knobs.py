@@ -31,6 +31,20 @@ class Knob:
 
 
 _ALL = (
+    Knob("TOS_AUTOSCALE", "bool", "1",
+         "Autoscaler kill switch: 0 makes cluster.autoscale() a no-op "
+         "(cluster.resize() stays available for manual scaling)."),
+    Knob("TOS_AUTOSCALE_COOLDOWN_SECS", "float", "30",
+         "Autoscaler hysteresis: hold window after any scale action before "
+         "the next one may fire (cooldown_hold decisions)."),
+    Knob("TOS_AUTOSCALE_MAX", "int", "8",
+         "Autoscaler upper bound on feedable node count (policy desired "
+         "counts are clamped into [MIN, MAX])."),
+    Knob("TOS_AUTOSCALE_MIN", "int", "1",
+         "Autoscaler lower bound on feedable node count."),
+    Knob("TOS_AUTOSCALE_TICK_SECS", "float", "5",
+         "Autoscaler cadence: seconds between policy decision cycles "
+         "(each tick samples cluster.stats over ~2 ticks of window)."),
     Knob("TOS_CONNECT_ATTEMPTS", "int", "3",
          "Dial attempts (with backoff + jitter) for control/data-plane "
          "clients before a connection error surfaces."),
@@ -43,6 +57,10 @@ _ALL = (
     Knob("TOS_DRAIN_STALL_TIMEOUT", "float", "300",
          "Elastic train() tail drain: stop waiting for buffered partitions "
          "after this long without consumption progress."),
+    Knob("TOS_DRAIN_TIMEOUT", "float", "60",
+         "cluster.resize scale-in: budget for a victim to drain (serving "
+         "in-flight + buffered partitions) and exit after EOF before the "
+         "reaper escalates to terminate."),
     Knob("TOS_EOF_TIMEOUT", "float", "20",
          "Budget (seconds) for the teardown-path EndOfFeed round-trip to "
          "each node."),

@@ -50,6 +50,29 @@ def metered_sum_batches(args, ctx):
         f.write(f"{total} {count}")
 
 
+def record_items(args, ctx):
+    """Slow consumer that records every item it consumed — the autoscale
+    coverage probe: the union of all nodes' files must cover the fed
+    records exactly (duplicates allowed, loss not), whatever resizes
+    happened mid-feed.  ``sleep_per_batch`` throttles consumption so a
+    resize demonstrably lands while partitions are still queued/buffered.
+
+    Each batch is appended and flushed as soon as it is consumed: the chaos
+    test SIGKILLs this process mid-drain, and a write-at-exit log would
+    silently lose every batch the victim consumed (the ledger only re-feeds
+    what the victim never reported consumed)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    out = os.path.join(args["out_dir"], f"node_{ctx.executor_id}.txt")
+    with open(out, "a") as f:
+        while not feed.should_stop():
+            batch = feed.next_batch(args["batch_size"])
+            if batch:
+                f.write("".join(f"{int(x)}," for x in batch))
+                f.flush()
+                if args.get("sleep_per_batch"):
+                    time.sleep(args["sleep_per_batch"])
+
+
 def echo_inference(args, ctx):
     """Classic inference loop: read batches, emit one result per input item."""
     feed = ctx.get_data_feed(train_mode=False)
