@@ -252,25 +252,55 @@ class DialDisciplineChecker(Checker):
 # expression's source text mentioning shard/tfrecord/part- is the signal.
 _SHARDISH_ARG = re.compile(r"shard|tfrecord|part-", re.IGNORECASE)
 _SHARD_OPEN_QUALS = frozenset({"open", "io.open", "gzip.open"})
+# View producers over shard buffers: confined tighter than binary opens
+# (tfrecord.py + dfutil.py only) because a view carries the zero-copy
+# LIFETIME contract — valid until its chunk is released, the whole shard
+# buffer pinned while it lives — and an ad-hoc producer hands out views
+# that no release/debug machinery tracks.
+_SHARD_VIEW_QUALS = frozenset({"memoryview", "mmap.mmap"})
 
 
 @register_checker
 class ShardIODisciplineChecker(Checker):
     """Binary reads of record-shard files are confined to tfrecord.py and
-    ingest/ — everything else must go through the verifying codecs."""
+    ingest/ — everything else must go through the verifying codecs.  Raw
+    buffer/mmap views of shard data are confined tighter still
+    (tfrecord.py/dfutil.py): view producers own the zero-copy lifetime
+    contract."""
 
     id = "shard-io-discipline"
     hint = ("read shards via tfrecord.read_records/read_record_spans (or "
             "the ingest pipeline / dfutil.read_shard) — a raw open() "
             "bypasses CRC verification and gzip detection")
+    view_hint = ("produce record views via tfrecord.record_views / "
+                 "read_record_spans (or dfutil.decode_span_columns) — "
+                 "ad-hoc memoryview/mmap slicing of shard data bypasses "
+                 "the zero-copy lifetime contract (views valid only until "
+                 "their chunk is released)")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
-        if mod.path.endswith("tfrecord.py") or "/ingest/" in mod.path:
+        if mod.path.endswith("tfrecord.py"):
             return
+        view_exempt = mod.path.endswith("dfutil.py")
+        open_exempt = "/ingest/" in mod.path
         for node, scope in _scoped_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             fq = mod.imports.qualify(node.func)
+            if fq in _SHARD_VIEW_QUALS:
+                if view_exempt:
+                    continue
+                call_src = ast.unparse(node)
+                if _SHARDISH_ARG.search(call_src):
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"raw shard-buffer view ({call_src[:60]}) outside "
+                        "tfrecord.py/dfutil.py bypasses the zero-copy "
+                        "lifetime contract",
+                        self.view_hint, f"{_qual(scope)}@{fq}")
+                continue
+            if open_exempt:
+                continue
             name = fq if fq in _SHARD_OPEN_QUALS else None
             if name is None and isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "read_bytes":

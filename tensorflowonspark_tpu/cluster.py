@@ -756,7 +756,8 @@ class TPUCluster:
 
     def train(self, data: Any, num_epochs: int = 1, qname: str = "input",
               shuffle_seed: int | None = None,
-              num_partitions: int | None = None) -> None:
+              num_partitions: int | None = None,
+              span_bytes: int | None = None) -> None:
         """Feed the workers for ``num_epochs`` epochs; blocks until all
         partitions are consumed (or nodes report 'terminating').
 
@@ -781,10 +782,18 @@ class TPUCluster:
         fencing apply unchanged — in DIRECT mode a dead node's unread
         shards are simply re-assigned to a survivor or its replacement.
 
+        Plain shards larger than ``span_bytes`` (default
+        ``TOS_INGEST_SPAN_BYTES``; 0 disables) split into record-aligned
+        *sub-shard* ledger items (``ingest.ShardSpan``), so N nodes
+        parallelize inside one multi-GB shard instead of pinning it to a
+        single reader — with the same at-least-once re-feed and recovery
+        semantics at span granularity.  Gzip shards always stay whole
+        (no byte-addressable record boundaries to split on).
+
         ``shuffle_seed`` reorders partitions differently each epoch
         (seed+epoch, deterministic) — the between-epochs shuffle the
         reference inherited from Spark/tf.data file shuffling; in DIRECT
-        mode this is a between-epochs *shard* shuffle.
+        mode this is a between-epochs *shard* (work-item) shuffle.
         """
         if self.input_mode == InputMode.DIRECT:
             from tensorflowonspark_tpu.ingest import shards_as_partitioned
@@ -799,16 +808,25 @@ class TPUCluster:
                     "input_mode=InputMode.STREAMING (reference: InputMode.SPARK)")
             if hasattr(data, "iter_partition"):
                 dataset = data  # pre-built partitions of paths: passthrough
-                num_shards = None
+                num_shards = num_items = None
             else:
-                from tensorflowonspark_tpu.ingest import enumerate_shards
+                from tensorflowonspark_tpu.ingest import (
+                    enumerate_shards,
+                    split_shards,
+                )
 
                 files = enumerate_shards(data)
                 num_shards = len(files)
-                dataset = shards_as_partitioned(files, num_partitions)
+                items = split_shards(files, span_bytes)
+                num_items = len(items)
+                dataset = shards_as_partitioned(items, num_partitions,
+                                                span_bytes=0)
             self.coordinator.set_manifest({
                 "kind": "tfrecord_shards", "qname": qname,
                 "num_shards": num_shards,
+                # work items the ledger feeds: == num_shards unless large
+                # plain shards were split into sub-shard span ranges
+                "num_items": num_items,
                 "num_partitions": dataset.num_partitions,
                 "num_epochs": num_epochs,
                 "spec": str(data) if isinstance(data, (str, os.PathLike)) else None,

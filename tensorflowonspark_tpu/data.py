@@ -237,7 +237,10 @@ def interleave(factories: Sequence[Callable[[], Iterator[Any]]],
 
 
 class _BytesColumn:
-    """A column of ``bytes`` rows; each row travels as its own buffer."""
+    """A column of ``bytes`` (or ``memoryview``) rows; each row travels as
+    its own buffer.  Memoryview rows — the ingest zero-copy record views —
+    scatter-gather straight from the shard buffer they slice; the receiver
+    rebuilds real ``bytes`` either way."""
 
     __slots__ = ("rows",)
 
@@ -250,7 +253,10 @@ class _BytesColumn:
         if protocol >= 5:
             return (_rebuild_bytes_column,
                     tuple(pickle.PickleBuffer(r) for r in self.rows))
-        return (_rebuild_bytes_column, tuple(self.rows))
+        # protocol < 5 cannot pickle memoryview at all: materialize
+        return (_rebuild_bytes_column,
+                tuple(bytes(r) if type(r) is memoryview else r
+                      for r in self.rows))
 
 
 def _rebuild_bytes_column(*bufs) -> "_BytesColumn":
@@ -313,9 +319,11 @@ def _pack_column(values: list):
     import numpy as np
 
     first = values[0]
-    if type(first) is bytes:
+    if type(first) is bytes or type(first) is memoryview:
+        # memoryview rows are the ingest zero-copy record views; mixing
+        # with bytes rows is fine (every row is its own buffer either way)
         if len(first) >= _MIN_OOB_ROW_BYTES and all(
-                type(v) is bytes for v in values):
+                type(v) in (bytes, memoryview) for v in values):
             return _BytesColumn(values)
         return None
     if isinstance(first, np.ndarray) and not first.dtype.hasobject:
@@ -352,10 +360,16 @@ class PackedChunk:
         return (PackedChunk, (self.layout, self.columns, self.meta))
 
     def __len__(self) -> int:
+        if self.layout == "columns":
+            return len(self.columns[0])  # the ColumnChunk itself
         col = self.columns[0]
         return len(col.rows if hasattr(col, "rows") else col)
 
     def rows(self) -> list:
+        if self.layout == "columns":
+            # a dfutil.ColumnChunk travelled whole (one contiguous buffer
+            # per numeric column); it owns the columns->rows expansion
+            return self.columns[0].rows()
         cols = [c.rows if hasattr(c, "rows") else c for c in self.columns]
         if self.layout == "flat":
             return cols[0]
@@ -371,7 +385,13 @@ class PackedChunk:
 def pack_chunk(items: list) -> PackedChunk | None:
     """Columnar-pack a homogeneous chunk, or None when it does not qualify
     (the caller then sends the plain list — semantics are identical either
-    way; packing only changes how the bytes travel)."""
+    way; packing only changes how the bytes travel).
+
+    A ``dfutil.ColumnChunk`` (the ingest pipeline's columnar decode
+    product) packs directly: its K contiguous column buffers ARE the
+    out-of-band frame (protocol 5 ships each ndarray column as one
+    buffer), and the receiver's ``unpack_items`` expands rows — no per-row
+    repack on either side."""
     packed = _pack_chunk_inner(items)
     # pack-vs-fallback counts: a feed that silently stopped qualifying for
     # the zero-copy path (heterogeneous rows, sub-threshold sizes) shows up
@@ -384,10 +404,14 @@ def pack_chunk(items: list) -> PackedChunk | None:
 
 
 def _pack_chunk_inner(items: list) -> PackedChunk | None:
+    from tensorflowonspark_tpu import dfutil
+
+    if isinstance(items, dfutil.ColumnChunk):
+        return PackedChunk("columns", (items,)) if len(items) else None
     if not items:
         return None
     first = items[0]
-    if type(first) is bytes or _is_ndarray(first):
+    if type(first) in (bytes, memoryview) or _is_ndarray(first):
         col = _pack_column(items)
         return PackedChunk("flat", (col,)) if col is not None else None
     if type(first) is tuple:
@@ -429,10 +453,48 @@ def _is_ndarray(x: Any) -> bool:
     return isinstance(x, np.ndarray)
 
 
+def materialize_views(items: list) -> list:
+    """bytes-ify memoryview rows (and views inside tuple/dict rows) that
+    did NOT qualify for out-of-band packing — plain pickle cannot
+    serialize memoryview at all, so a sub-threshold zero-copy record
+    reaching the wire unpacked must materialize here rather than crash
+    deep in the transport.  Returns ``items`` unchanged when nothing
+    needs fixing (the overwhelmingly common case)."""
+
+    def _dirty(v) -> bool:
+        if type(v) is memoryview:
+            return True
+        if type(v) in (tuple, list):
+            return any(type(x) is memoryview for x in v)
+        if type(v) is dict:
+            return any(type(x) is memoryview for x in v.values())
+        return False
+
+    def _fix(v):
+        if type(v) is memoryview:
+            return bytes(v)
+        if type(v) in (tuple, list) and _dirty(v):
+            fixed = [bytes(x) if type(x) is memoryview else x for x in v]
+            return tuple(fixed) if type(v) is tuple else fixed
+        if type(v) is dict and _dirty(v):
+            return {k: bytes(x) if type(x) is memoryview else x
+                    for k, x in v.items()}
+        return v
+
+    if not isinstance(items, list):
+        return items
+    if any(_dirty(v) for v in items):
+        return [_fix(x) for x in items]
+    return items
+
+
 def unpack_items(items: Any) -> list:
-    """Server-side inverse of ``pack_chunk``: a PackedChunk becomes its row
+    """Server-side inverse of ``pack_chunk``: a PackedChunk (or a bare
+    ``dfutil.ColumnChunk`` fed as one pre-packed item) becomes its row
     list; anything else passes through unchanged (old peers send lists)."""
     if isinstance(items, PackedChunk):
+        return items.rows()
+    if hasattr(items, "rows") and hasattr(items, "counts"):  # ColumnChunk
         return items.rows()
     return items
 

@@ -51,6 +51,7 @@ from typing import Any, Iterable
 from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.data import _MIN_OOB_ROW_BYTES as _MIN_OOB_BYTES
+from tensorflowonspark_tpu.data import materialize_views as _materialize_views
 from tensorflowonspark_tpu.data import pack_chunk as _pack_chunk
 from tensorflowonspark_tpu.data import unpack_items as _unpack_items
 from tensorflowonspark_tpu.feeding import FeedQueues
@@ -815,14 +816,17 @@ class DataClient:
 
     def _pack_items(self, chunk: list) -> Any:
         """Columnar-pack a chunk for the v2 wire (``data.pack_chunk``); v1
-        peers (and unpackable chunks) get the plain row list."""
+        peers (and unpackable chunks) get the plain row list — with any
+        stray zero-copy views materialized to bytes first (sub-threshold
+        memoryview records fall out of packing, and plain pickle cannot
+        serialize memoryview at all)."""
         if self._wire >= 2:
             packed = _pack_chunk(chunk)
             if packed is not None:
                 return packed
-            return chunk
+            return _materialize_views(chunk)
         telemetry.counter("dataplane.chunks_legacy_wire").inc()
-        return chunk
+        return _materialize_views(chunk)
 
     def feed_partition(self, items: Iterable[Any], qname: str = "input",
                        task_key: Any = None, trace: Any = None) -> str:

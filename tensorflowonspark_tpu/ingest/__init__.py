@@ -21,7 +21,10 @@ Pieces:
 
 Knobs: ``TOS_INGEST_READERS`` (reader-pool ceiling), ``TOS_INGEST_PREFETCH``
 (decoded-chunk prefetch depth), ``TOS_INGEST_AUTOTUNE`` (occupancy-driven
-pool sizing).
+pool sizing), ``TOS_INGEST_ZEROCOPY`` (memoryview record views — 0 restores
+bytes copies, ``debug`` makes retained views fail loudly),
+``TOS_INGEST_SPAN_BYTES`` (sub-shard split granularity; 0 keeps shards
+whole).
 """
 
 from tensorflowonspark_tpu.ingest.feed import IngestFeed  # noqa: F401
@@ -33,6 +36,8 @@ from tensorflowonspark_tpu.ingest.readers import (  # noqa: F401
     prefetch_iterator,
 )
 from tensorflowonspark_tpu.ingest.shards import (  # noqa: F401
+    ShardSpan,
     enumerate_shards,
     shards_as_partitioned,
+    split_shards,
 )

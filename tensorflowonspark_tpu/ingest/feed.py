@@ -41,6 +41,7 @@ from time import monotonic as _monotonic
 from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.feeding import FeedQueues, batch_to_columns
 from tensorflowonspark_tpu.ingest.readers import ReaderPipeline, ShardDone
+from tensorflowonspark_tpu.ingest.shards import ShardSpan
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 
@@ -66,14 +67,35 @@ class IngestFeed:
     ``batch_results``/``terminate``, drop-in for ``DataFeed`` inside a
     map_fun.
 
-    Deltas from ``DataFeed`` (both deliberate): batches are record payloads
-    (``bytes``, or whatever ``decode`` returns), and SHARD seams inside a
-    ledger partition never truncate batches — shards interleave freely.  A
-    completed *ledger partition* does close the running batch (partial,
-    like DataFeed's EndPartition): the records must reach the map_fun
-    before the partition may be reported consumed, and holding them while
-    blocking for more data would freeze the watermark the driver's elastic
-    tail drain polls.
+    Deltas from ``DataFeed`` (all deliberate): batches are record payloads
+    (zero-copy ``memoryview`` slices of the shard buffer by default — see
+    the decode contract below — or whatever ``decode`` returns), and SHARD
+    seams inside a ledger partition never truncate batches — shards
+    interleave freely.  A completed *ledger partition* does close the
+    running batch (partial, like DataFeed's EndPartition): the records
+    must reach the map_fun before the partition may be reported consumed,
+    and holding them while blocking for more data would freeze the
+    watermark the driver's elastic tail drain polls.
+
+    **Zero-copy decode contract** (``TOS_INGEST_ZEROCOPY``, default on):
+    records from plain shards are ``memoryview`` slices — no copy between
+    the disk read and the map_fun.  A view is *valid until its batch is
+    released*: a batch retires when the map_fun comes back for the next
+    one, so the batch in hand is always safe — finish with it before
+    calling ``next_batch`` again.  Retaining views longer pins whole
+    shard buffers in memory — copy (``bytes(view)``) anything you keep.
+    ``TOS_INGEST_ZEROCOPY=0`` restores plain ``bytes`` records;
+    ``=debug`` keeps zero-copy but *releases* each batch's views on
+    retirement, so a retained view raises ``ValueError`` at first touch
+    instead of silently leaking.  Gzip shards always deliver ``bytes``.
+
+    **Columnar mode** (``schema=``, a ``dfutil.Schema``): batches are
+    ``{column: values}`` dicts sliced zero-copy out of the readers'
+    ``dfutil.ColumnChunk``s — fixed-width numeric columns as ``[n]`` /
+    ``[n, k]`` ndarray views, ragged columns as ``(values, counts)``
+    pairs.  Batches never span chunks (a batch may come back short at a
+    chunk boundary — same "up to batch_size" contract as everywhere
+    else); ``input_mapping`` renames columns instead of reshaping rows.
     """
 
     def __init__(
@@ -91,6 +113,9 @@ class IngestFeed:
         verify: bool = True,
         prefetch: int | None = None,
         autotune: bool | None = None,
+        zerocopy=None,
+        schema=None,
+        binary_features=None,
     ):
         self.queues = queues
         self.train_mode = train_mode
@@ -110,7 +135,16 @@ class IngestFeed:
         self.pipeline = ReaderPipeline(
             readers=readers, autotune=autotune, prefetch=prefetch,
             chunk_records=chunk_records, decode=decode, verify=verify,
-            stop_event=self._abandon)
+            stop_event=self._abandon, zerocopy=zerocopy, schema=schema,
+            binary_features=binary_features)
+        # debug zero-copy: views handed out in the LAST returned batch;
+        # released (-> late access raises ValueError) when that batch
+        # retires at the next next_batch call
+        self._debug_release = self.pipeline.zerocopy == "debug"
+        self._prev_views: list = []
+        # columnar mode: the partially-served ColumnChunk + its row offset
+        self._colchunk = None
+        self._coloff = 0
         # rolling feed-queue occupancy (the autoscaling signal
         # cluster.stats() serves per node, same gauge as DataFeed): in
         # DIRECT mode the reader pipeline's prefetch queue IS the feed queue
@@ -157,9 +191,10 @@ class IngestFeed:
                     return
                 if isinstance(item, Marker):
                     continue
-                if not isinstance(item, str):
+                if not isinstance(item, (str, ShardSpan)):
                     raise TypeError(
-                        f"DIRECT-mode feed expects shard PATHS on queue "
+                        f"DIRECT-mode feed expects shard PATHS (or ShardSpan "
+                        f"sub-shard items) on queue "
                         f"{self.qname_in!r}, got {type(item).__name__}: "
                         "feed this cluster with cluster.train(<path_or_glob>) "
                         "(InputMode.STREAMING is the mode that streams rows)")
@@ -225,12 +260,29 @@ class IngestFeed:
     def next_batch(self, batch_size: int) -> list | dict:
         """Pop up to ``batch_size`` decoded records; the batch goes partial
         at end-of-feed / stop / a completed ledger partition (shard seams
-        inside a partition never truncate it)."""
+        inside a partition never truncate it) / a columnar chunk boundary.
+        Calling this RELEASES the previous batch (see the zero-copy decode
+        contract in the class docstring)."""
+        if self._prev_views:
+            # debug zero-copy: the previous batch retires NOW — releasing
+            # its views makes any retained one fail loudly at first touch
+            for v in self._prev_views:
+                v.release()
+            self._prev_views = []
         self._report_ready_keys()  # the previous batch has been handed over
         batch: list = []
         while len(batch) < batch_size:
+            if self._colchunk is not None:
+                return self._columnar_batch(batch_size)
             if self._leftover:
                 take = batch_size - len(batch)
+                if not batch and take >= len(self._leftover):
+                    # whole chunk fits an empty batch: adopt the list
+                    # instead of copying it element-wise (the hot shape —
+                    # batch_size >= chunk_records)
+                    batch = self._leftover
+                    self._leftover = []
+                    continue
                 batch.extend(self._leftover[:take])
                 del self._leftover[:take]
                 continue
@@ -279,6 +331,12 @@ class IngestFeed:
             if isinstance(item, ShardDone):
                 self._on_shard_done(item, batch_empty=not batch)
                 continue
+            if hasattr(item, "slice") and hasattr(item, "counts"):
+                # a dfutil.ColumnChunk (schema mode): served by slicing at
+                # the loop top — record chunks never mix with these (the
+                # schema drives EVERY shard through the columnar decoder)
+                self._colchunk, self._coloff = item, 0
+                continue
             self._leftover = item  # one decoded chunk (a list)
         if batch:
             self._occupancy.set(self.pipeline.depth())
@@ -287,14 +345,49 @@ class IngestFeed:
             # same chaos clock as DataFeed: `kill:after_batches=N` fires on
             # consumed batches, so kill-mid-shard tests run in DIRECT mode
             faultinject.batch_consumed()
+            if self._debug_release:
+                self._prev_views = [r for r in batch
+                                    if type(r) is memoryview]
         if self.input_mapping:
             return batch_to_columns(batch, self.input_mapping)
         return batch
 
+    def _columnar_batch(self, batch_size: int) -> dict:
+        """Serve up to ``batch_size`` records off the current ColumnChunk
+        as zero-copy column views; batches never span chunks (numpy views
+        cannot cross two buffers without a copy — a short batch at a chunk
+        boundary is the documented trade)."""
+        chunk, off = self._colchunk, self._coloff
+        take = min(batch_size, len(chunk) - off)
+        out = chunk.slice(off, off + take)
+        off += take
+        if off >= len(chunk):
+            self._colchunk, self._coloff = None, 0
+        else:
+            self._coloff = off
+        self._occupancy.set(self.pipeline.depth())
+        telemetry.counter("feed.batches").inc()
+        telemetry.counter("feed.rows_consumed").inc(take)
+        faultinject.batch_consumed()
+        if self.input_mapping:
+            # same {column -> tensor name} contract as batch_to_columns,
+            # minus the per-row reshaping the columns never needed
+            return {tname: out[cname]
+                    for cname, tname in self.input_mapping.items()}
+        return out
+
     # -- producing results ---------------------------------------------------
 
     def batch_results(self, results: Iterable[Any], chunk: bool = False) -> None:
-        """Emit results to the output queue (parity with ``DataFeed``)."""
+        """Emit results to the output queue (parity with ``DataFeed``).
+
+        Zero-copy record views are materialized to ``bytes`` here: a
+        result outlives its batch by definition (the decode contract says
+        copy what you keep), and views queued raw would pin shard buffers
+        AND be unpicklable on the collect wire."""
+        from tensorflowonspark_tpu.data import materialize_views
+
+        results = materialize_views(list(results))
         q = self.queues.get_queue(self.qname_out)
         if chunk:
             q.put(ResultChunk(results))

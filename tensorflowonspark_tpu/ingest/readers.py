@@ -9,12 +9,17 @@ TFRecord *shard paths* and reads the bytes itself —
                           read + CRC-verify     (the prefetch buffer)
                           + decode
 
-- **Readers** pull whole shards off the shared work queue (tf.data's
-  ``interleave(cycle_length=N)``): plain shards via one
-  ``tfrecord.read_record_spans`` IO read + native CRC scan, gzip shards via
-  streaming decompression (never a whole-file inflate).  An optional
-  ``decode`` callable runs per record inside the reader thread, so decode
-  parallelism rides reader parallelism.
+- **Readers** pull work items — whole shard paths, or ``ShardSpan``
+  sub-shard byte ranges of a large plain shard — off the shared work queue
+  (tf.data's ``interleave(cycle_length=N)``): plain shards/ranges via one
+  IO read + native CRC scan, then ZERO-COPY ``memoryview`` record slices
+  (``TOS_INGEST_ZEROCOPY``; no per-record copy between disk and consumer),
+  gzip shards via streaming decompression (never a whole-file inflate,
+  always ``bytes``).  An optional ``decode`` callable runs per record
+  inside the reader thread, so decode parallelism rides reader
+  parallelism; a ``schema`` routes records through COLUMNAR Example decode
+  instead (``dfutil.decode_span_columns`` — chunks materialize as K
+  contiguous column buffers, no per-record parse).
 - **The chunk queue is the prefetch buffer** (``TOS_INGEST_PREFETCH``
   chunks deep): readers run ahead of the consumer by up to that many
   decoded chunks, and block (backpressure) beyond it.
@@ -38,8 +43,10 @@ import time
 
 from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.ingest.shards import ShardSpan
 from tensorflowonspark_tpu.utils.envtune import env_bool as _env_bool
 from tensorflowonspark_tpu.utils.envtune import env_int as _env_int
+from tensorflowonspark_tpu.utils.envtune import env_str as _env_str
 from tensorflowonspark_tpu.utils.paths import resolve_uri
 
 logger = logging.getLogger(__name__)
@@ -57,6 +64,27 @@ _EMA_ALPHA = 0.3
 class ShardReadError(RuntimeError):
     """A reader thread failed on a shard (corrupt CRC, IO error, decode
     bug); re-raised at the consumer with the shard path attached."""
+
+
+def zerocopy_mode(zerocopy=None) -> str:
+    """Resolve a zero-copy setting to ``'on'`` / ``'off'`` / ``'debug'``.
+
+    ``None`` reads ``TOS_INGEST_ZEROCOPY`` (default on); booleans and the
+    knob's string values both normalize.  ``debug`` is zero-copy PLUS
+    release tracking: the feed releases delivered views when their batch
+    retires, so code that retains a view past the documented lifetime gets
+    a loud ``ValueError`` instead of silently pinning shard buffers.
+    """
+    if zerocopy is None:
+        zerocopy = _env_str("TOS_INGEST_ZEROCOPY", "1")
+    if isinstance(zerocopy, bool):
+        return "on" if zerocopy else "off"
+    mode = str(zerocopy).strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return "off"
+    if mode == "debug":
+        return "debug"
+    return "on"
 
 
 class ShardDone:
@@ -93,9 +121,29 @@ class ReaderPipeline:
     def __init__(self, *, readers: int | None = None,
                  autotune: bool | None = None, prefetch: int | None = None,
                  chunk_records: int = 256, decode=None, verify: bool = True,
-                 stop_event: threading.Event | None = None):
+                 stop_event: threading.Event | None = None,
+                 zerocopy=None, schema=None, binary_features=None):
         self._max_readers = max(0, readers if readers is not None
                                 else _env_int("TOS_INGEST_READERS", 4, minimum=0))
+        # Zero-copy decode contract (TOS_INGEST_ZEROCOPY, default ON): plain
+        # shards deliver records as MEMORYVIEW slices of the shard buffer —
+        # no per-record copy between the disk read and the consumer.  Each
+        # view pins the whole buffer, so holders must drop/copy views once
+        # their chunk is released (the feed layer defines release as batch
+        # retirement); 'off' restores bytes copies, 'debug' releases
+        # delivered views so late access fails loudly.  Gzip shards always
+        # deliver bytes (stream-decompressed; no stable buffer to view).
+        self.zerocopy = zerocopy_mode(zerocopy)
+        # Columnar Example decode (schema=...): chunks materialize as
+        # dfutil.ColumnChunk — K contiguous column buffers straight from
+        # the span scan (native parser when built) instead of per-record
+        # parse + per-row repack.  Mutually exclusive with decode= (the
+        # schema IS the decoder).
+        if schema is not None and decode is not None:
+            raise ValueError("schema= and decode= are mutually exclusive: "
+                             "columnar decode is driven by the schema")
+        self.schema = schema
+        self.binary_features = binary_features
         # readers=0: SYNCHRONOUS mode — no reader threads at all, get()
         # reads the next shard inline in the consumer thread (the tf.data
         # ``num_parallel_calls=None`` analogue).  Trades away read/compute
@@ -130,9 +178,10 @@ class ReaderPipeline:
 
     # -- producer side -------------------------------------------------------
 
-    def submit(self, path: str, tag=None) -> None:
-        """Queue one shard path for a reader to claim; ``tag`` rides the
-        shard's ``ShardDone`` token back to the consumer."""
+    def submit(self, path, tag=None) -> None:
+        """Queue one work item — a shard path, or a :class:`ShardSpan`
+        sub-shard range — for a reader to claim; ``tag`` rides the item's
+        ``ShardDone`` token back to the consumer."""
         self._work.put((path, tag))
 
     def close(self) -> None:
@@ -190,13 +239,25 @@ class ReaderPipeline:
             return item
         if self._stop.is_set():
             return None
-        try:
-            path, tag = self._work.get(timeout=timeout)
-        except queue.Empty:
-            with self._lock:
-                if self._closed:
-                    return None
-            raise
+        with self._lock:
+            closed = self._closed
+        if closed:
+            # close() precedes no further submits: an empty work queue IS
+            # the drain — answer now instead of blocking a full timeout
+            # only to discover it (the stall used to add one poll_interval
+            # to EVERY sync-mode feed's tail)
+            try:
+                path, tag = self._work.get_nowait()
+            except queue.Empty:
+                return None
+        else:
+            try:
+                path, tag = self._work.get(timeout=timeout)
+            except queue.Empty:
+                with self._lock:
+                    if self._closed:
+                        return None
+                raise
         try:
             with telemetry.timed("ingest.shard_read_secs"):
                 self._read_one(path, tag)
@@ -296,44 +357,158 @@ class ReaderPipeline:
                     # at which point nobody would read the sentinel anyway
                     self._put(_DRAINED)
 
-    def _read_one(self, path: str, tag) -> None:
-        """Read + verify one whole shard, pushing decoded chunks then the
-        shard's ``ShardDone``.  Plain shards take the span path — ONE open,
-        one native CRC scan, per-record slices (on remote filesystems every
-        extra open is a metadata round-trip); gzip shards stream (probe
-        open + gzip.open)."""
-        local = resolve_uri(path)
-        decode = self.decode
-        nbytes = 0
-        nrecs = 0
-        chunk: list = []
-        with open(local, "rb") as f:
-            gz = tfrecord._is_gzip_shard(f.read(12))
-            if gz:
-                buf = None
+    def _read_one(self, item, tag) -> None:
+        """Read + verify one work item (whole shard, or a ``ShardSpan``
+        sub-shard range), pushing decoded chunks then the item's
+        ``ShardDone``.  Plain shards take the span path — ONE open, one
+        native CRC scan, then zero-copy ``memoryview`` record slices (or
+        bytes copies with ``TOS_INGEST_ZEROCOPY=0``); with ``schema`` set,
+        chunks of spans decode columnar (``dfutil.decode_span_columns``)
+        into contiguous column buffers instead.  Gzip shards stream (probe
+        open + gzip.open) and always deliver bytes."""
+        # Zero-copy record mode maps the shard instead of read()ing it:
+        # the CRC scan and the record views walk page-cache pages
+        # directly, saving a full DRAM copy pass per shard — the pass
+        # that caps aggregate multi-node ingest of one large shard.
+        # Columnar and bytes-copy modes keep the bytes read (their
+        # decoders materialize/copy anyway).
+        use_map = self.schema is None and self.zerocopy != "off"
+        if isinstance(item, ShardSpan):
+            local = resolve_uri(item.path)
+            gz = False
+            if use_map:
+                buf, spans = tfrecord.map_span_range(local, item.start,
+                                                     item.end, self.verify)
             else:
-                f.seek(0)
-                buf = f.read()  # one read, no probe+rest concat copy
-        if gz:
+                buf, spans = tfrecord.read_span_range(local, item.start,
+                                                      item.end, self.verify)
+        else:
+            local = resolve_uri(item)
+            buf = None  # stays None for gzip shards (they stream)
+            if use_map:
+                # ONE open: gzip probe off the mapped head + CRC scan
+                buf, spans = tfrecord.map_record_spans(local, self.verify)
+                gz = buf is None
+            else:
+                with open(local, "rb") as f:
+                    gz = tfrecord._is_gzip_shard(f.read(12))
+                    if not gz:
+                        f.seek(0)
+                        buf = f.read()  # one read, no probe+rest concat copy
+                if not gz:
+                    spans = tfrecord.scan_record_spans(buf, self.verify,
+                                                       name=local)
+        if self.schema is not None:
+            nrecs, nbytes = self._read_columnar(local, buf,
+                                                None if gz else spans, gz)
+            if nrecs is None:
+                return  # stopped with the consumer gone
+        elif not gz:
+            # span fast path: with no decode callable, chunks are plain
+            # list windows — no per-record append/accounting loop on the
+            # hot path.  Views materialize eagerly (pure slice objects,
+            # ~100 ns each, no payload bytes); the BYTES-copy mode slices
+            # per window INSIDE the push loop so the bounded prefetch
+            # queue keeps pacing the memcpy cost — an eager full-shard
+            # copy list would double peak memory per reader.
+            zc = self.zerocopy != "off"
+            decode = self.decode
+            nrecs = len(spans)
+            nbytes = sum(length for _, length in spans)
+            cr = self.chunk_records
+            if decode is None:
+                records = tfrecord.record_views(buf, spans) if zc else None
+                for i in range(0, nrecs, cr):
+                    chunk = (records[i:i + cr] if zc else
+                             [buf[off:off + length]
+                              for off, length in spans[i:i + cr]])
+                    if not self._put(chunk):
+                        return  # stopped with the consumer gone
+            else:
+                # decode INTERLEAVED with chunk pushes: per-record decode
+                # cost paces the queue, so the autotuner's pop-time
+                # occupancy sampling sees the decode rate, not one
+                # end-of-shard burst.  Decode callables keep their
+                # PRE-EXISTING bytes contract (bytes() of a bytes slice is
+                # the same object; of an mmap view, the one per-record
+                # copy — noise next to per-record Python decode): handing
+                # views to decoders written against bytes would crash
+                # every one of them for no measurable win.
+                chunk: list = []
+                for off, length in spans:
+                    chunk.append(decode(bytes(buf[off:off + length])))
+                    if len(chunk) >= cr:
+                        if not self._put(chunk):
+                            return
+                        chunk = []
+                if chunk and not self._put(chunk):
+                    return
+        else:
             payloads = tfrecord.read_records(local, verify=self.verify,
                                              gzipped=True)
-        else:
-            spans = tfrecord.scan_record_spans(buf, self.verify, name=local)
-            payloads = (buf[off:off + length] for off, length in spans)
-        for payload in payloads:
-            nbytes += len(payload)
-            nrecs += 1
-            chunk.append(decode(payload) if decode is not None else payload)
-            if len(chunk) >= self.chunk_records:
-                if not self._put(chunk):
-                    return  # stopped with the consumer gone
-                chunk = []
-        if chunk and not self._put(chunk):
-            return
-        self._put(ShardDone(path, tag))
+            decode = self.decode
+            nbytes = 0
+            nrecs = 0
+            chunk: list = []
+            for payload in payloads:
+                nbytes += len(payload)
+                nrecs += 1
+                chunk.append(decode(payload) if decode is not None else payload)
+                if len(chunk) >= self.chunk_records:
+                    if not self._put(chunk):
+                        return  # stopped with the consumer gone
+                    chunk = []
+            if chunk and not self._put(chunk):
+                return
+        self._put(ShardDone(item, tag))
         telemetry.counter("ingest.shards_read").inc()
         telemetry.counter("ingest.records_read").inc(nrecs)
         telemetry.counter("ingest.bytes_read").inc(nbytes)
+
+    def _read_columnar(self, local: str, buf, spans, gz: bool):
+        """Columnar (schema) decode of one work item: every
+        ``chunk_records`` spans become ONE ``dfutil.ColumnChunk`` — the
+        native parser turns a span window into K contiguous column buffers
+        without a per-record Python hop; gzip shards accumulate streamed
+        records into the same chunk shape.  Returns ``(nrecs, nbytes)``,
+        or ``(None, None)`` when the pipeline stopped mid-item."""
+        from tensorflowonspark_tpu import dfutil
+
+        cr = self.chunk_records
+        nrecs = 0
+        nbytes = 0
+        if not gz:
+            for i in range(0, len(spans), cr):
+                window = spans[i:i + cr]
+                cols, counts = dfutil.decode_span_columns(
+                    buf, window, self.schema, self.binary_features)
+                if not self._put(dfutil.ColumnChunk.from_schema(
+                        cols, counts, self.schema)):
+                    return None, None
+                nrecs += len(window)
+                nbytes += sum(length for _, length in window)
+            return nrecs, nbytes
+        batch: list = []
+        for payload in tfrecord.read_records(local, verify=self.verify,
+                                             gzipped=True):
+            batch.append(payload)
+            nbytes += len(payload)
+            if len(batch) >= cr:
+                cols, counts = dfutil.records_to_columns(
+                    batch, self.schema, self.binary_features)
+                if not self._put(dfutil.ColumnChunk.from_schema(
+                        cols, counts, self.schema)):
+                    return None, None
+                nrecs += len(batch)
+                batch = []
+        if batch:
+            cols, counts = dfutil.records_to_columns(
+                batch, self.schema, self.binary_features)
+            if not self._put(dfutil.ColumnChunk.from_schema(
+                    cols, counts, self.schema)):
+                return None, None
+            nrecs += len(batch)
+        return nrecs, nbytes
 
     def _put(self, item) -> bool:
         """Bounded put that stays responsive to stop(): blocking on the full

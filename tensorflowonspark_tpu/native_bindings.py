@@ -36,16 +36,31 @@ def crc32c(data: bytes, crc: int = 0) -> int:
 _SCAN_CHUNK = 65536
 
 
-def scan_records(buf: bytes, verify: bool = True):
-    """Return ([(offset, length), ...], consumed_bytes); raises on corruption."""
+def scan_records(buf, verify: bool = True):
+    """Return ([(offset, length), ...], consumed_bytes); raises on corruption.
+
+    ``buf`` is ``bytes`` or any read-only buffer-protocol object (an mmap
+    or a memoryview of one — the zero-copy shard path): non-bytes buffers
+    are passed by ADDRESS so the scan walks the mapped pages directly,
+    with no copy into a bytes object."""
     offs = (ctypes.c_uint64 * _SCAN_CHUNK)()
     lens = (ctypes.c_uint64 * _SCAN_CHUNK)()
     consumed = ctypes.c_uint64()
     spans: list[tuple[int, int]] = []
     base = 0
-    view = buf
+    total = len(buf)
+    addr = None
+    if not isinstance(buf, bytes):
+        import numpy as np
+
+        anchor = np.frombuffer(buf, np.uint8)  # keeps the buffer pinned
+        addr = anchor.ctypes.data
     while True:
-        n = _lib.tos_scan_records(view, len(view), int(verify), offs, lens,
+        if addr is not None:
+            view = ctypes.cast(addr + base, ctypes.c_char_p)
+        else:
+            view = buf if base == 0 else buf[base:]
+        n = _lib.tos_scan_records(view, total - base, int(verify), offs, lens,
                                   _SCAN_CHUNK, ctypes.byref(consumed))
         if n < 0:
             raise ValueError(f"corrupt record at offset {base + consumed.value}")
@@ -53,7 +68,6 @@ def scan_records(buf: bytes, verify: bool = True):
         base += consumed.value
         if n < _SCAN_CHUNK:
             return spans, base
-        view = buf[base:]  # re-slice only for shards >64k records per pass
 
 
 def frame_record(data: bytes) -> bytes:

@@ -168,14 +168,26 @@ class NodeContext:
         verify: bool = True,
         prefetch: int | None = None,
         autotune: bool | None = None,
+        zerocopy=None,
+        schema=None,
+        binary_features=None,
     ):
-        """DIRECT-mode feed: shard paths in, decoded record batches out.
+        """DIRECT-mode feed: shard paths (or sub-shard spans) in, decoded
+        record batches out.
 
-        ``decode`` runs per record inside the reader threads (e.g.
+        Records from plain shards are zero-copy ``memoryview`` slices by
+        default (``zerocopy`` overrides ``TOS_INGEST_ZEROCOPY``; views are
+        valid until their batch retires — see the ``IngestFeed`` decode
+        contract).  ``decode`` runs per record inside the reader threads
+        and ALWAYS receives ``bytes`` — the pre-existing contract (e.g.
         ``lambda rec: dfutil.from_example(rec, schema)``); ``None`` yields
-        raw payload ``bytes``.  ``readers``/``prefetch``/``autotune``
-        override the ``TOS_INGEST_*`` knobs; ``verify=False`` skips CRC
-        checks for trusted local data.
+        the raw payloads.  ``schema`` (a ``dfutil.Schema``)
+        switches to COLUMNAR Example decode instead: batches arrive as
+        ``{column: ndarray-view}`` dicts materialized from contiguous
+        column buffers in the reader pool (mutually exclusive with
+        ``decode``).  ``readers``/``prefetch``/``autotune`` override the
+        ``TOS_INGEST_*`` knobs; ``verify=False`` skips CRC checks for
+        trusted local data.
         """
         from tensorflowonspark_tpu.ingest import IngestFeed
 
@@ -183,7 +195,8 @@ class NodeContext:
             self.queues, train_mode, qname_in, qname_out, input_mapping,
             stop_event=self.stop_requested, readers=readers, decode=decode,
             chunk_records=chunk_records, verify=verify, prefetch=prefetch,
-            autotune=autotune)
+            autotune=autotune, zerocopy=zerocopy, schema=schema,
+            binary_features=binary_features)
 
     def job_manifest(self) -> dict:
         """The driver-published description of the current DIRECT-mode feed

@@ -281,11 +281,17 @@ class TPUEstimator(TPUParams):
     map_fun exported a SavedModel the same way).
 
     ``epochs`` semantics by input mode (same split as the reference): in
-    STREAMING mode the *driver* replays the dataset ``epochs`` times through
-    the feed; in DIRECT mode the framework never touches the data, so the
-    train_fn owns the epoch loop and reads ``args.epochs`` itself (as
-    ``examples/mnist/mnist_tfr.py`` does) — the Param is plumbed through
-    either way.
+    STREAMING mode the *driver* replays the dataset ``epochs`` times
+    through the feed.  In DIRECT mode ``fit`` now ALSO drives the
+    ledger-backed ingest feed whenever it has a shard spec — a path /
+    glob / list-of-paths dataset, or rows staged via ``tfrecord_dir`` —
+    so ``cluster.train(spec, num_epochs=epochs)`` replays the shard set
+    through the partition ledger and the train_fn inherits at-least-once
+    re-feed, sub-shard parallelism, and elastic recovery by consuming
+    ``ctx.get_data_feed()`` (the reference's estimator stayed
+    self-service here).  Self-service train_fns that read files
+    themselves instead of consuming the feed keep working: the path feed
+    is tiny and is drained at shutdown.
     """
 
     def __init__(self, train_fn: Callable, tf_args: Any = None,
@@ -312,15 +318,36 @@ class TPUEstimator(TPUParams):
         if args.get("export_dir") is None:
             raise ValueError("TPUEstimator requires export_dir (the model artifact path)")
         input_mode = args.input_mode
-        data = as_partitioned(dataset, default_partitions=max(1, args.num_executors))
+        # DIRECT + a shard spec (path/glob/dir or list of paths): nothing
+        # to partition driver-side — the spec goes straight to the
+        # ledger-driven ingest feed below.  A path that does NOT resolve
+        # to TFRecord shards (e.g. a raw-image directory a self-service
+        # train_fn reads its own way) is left alone: the previous
+        # releases' self-service contract must keep working.
+        shard_spec = _as_shard_spec(dataset) if input_mode == InputMode.DIRECT \
+            else None
+        if shard_spec is not None:
+            from tensorflowonspark_tpu.ingest import enumerate_shards
+
+            try:
+                enumerate_shards(shard_spec)
+            except FileNotFoundError as e:
+                logger.warning(
+                    "DIRECT fit: %s — leaving the train_fn self-service "
+                    "(no ledger-driven ingest feed for this dataset)", e)
+                shard_spec = None
+        data = None if shard_spec is not None else as_partitioned(
+            dataset, default_partitions=max(1, args.num_executors))
         if args.get("tfrecord_dir"):
             # Stage to TFRecords so DIRECT-mode train_fns can read files
             # (reference: dfutil.saveAsTFRecords before TFCluster.run).
-            rows = data if _is_row_data(data) else None
+            rows = data if data is not None and _is_row_data(data) else None
             if rows is None:
                 raise ValueError("tfrecord_dir staging requires row-dict datasets")
             dfutil.save_as_tfrecords(rows, args.tfrecord_dir)
             args.merge({"data_dir": args.tfrecord_dir})
+            if input_mode == InputMode.DIRECT:
+                shard_spec = args.tfrecord_dir  # feed the staged shards
         cluster = _cluster.run(
             self.train_fn,
             args,
@@ -339,6 +366,14 @@ class TPUEstimator(TPUParams):
         try:
             if input_mode == InputMode.STREAMING:
                 cluster.train(data, num_epochs=args.epochs,
+                              shuffle_seed=args.shuffle_seed)
+            elif shard_spec is not None:
+                # DIRECT onto the ledger-driven ingest feed: shard (and
+                # sub-shard span) work items flow through the partition
+                # ledger, so the pipeline layer inherits at-least-once
+                # re-feed and elastic recovery instead of staying
+                # self-service
+                cluster.train(shard_spec, num_epochs=args.epochs,
                               shuffle_seed=args.shuffle_seed)
         finally:
             try:
@@ -504,6 +539,20 @@ def merge_prediction_rows(rows: list, preds: list, output_mapping: dict) -> list
                 row_out[col] = np.asarray(pred)
         out.append(row_out)
     return out
+
+
+def _as_shard_spec(dataset: Any):
+    """A DIRECT-mode fit dataset that is already a shard spec (path, glob,
+    directory, or list of paths) — returned as-is for the ledger feed;
+    None means row data (needs ``tfrecord_dir`` staging)."""
+    import os
+
+    if isinstance(dataset, (str, os.PathLike)):
+        return dataset
+    if isinstance(dataset, (list, tuple)) and dataset and all(
+            isinstance(p, (str, os.PathLike)) for p in dataset):
+        return list(dataset)
+    return None
 
 
 def _is_row_data(data: PartitionedDataset) -> bool:

@@ -100,6 +100,10 @@ def scan_record_spans(buf: bytes, verify: bool = True,
         if consumed != len(buf):
             raise RecordError(f"{name}: truncated record at offset {consumed}")
         return [(int(o), int(n)) for o, n in spans]
+    if not isinstance(buf, (bytes, bytearray)):
+        # pure-Python fallback slices header/payload windows for the CRC
+        # helper, which wants real bytes; one copy beats a copy per record
+        buf = bytes(buf)
     spans = []
     pos = 0
     while pos < len(buf):
@@ -117,6 +121,141 @@ def scan_record_spans(buf: bytes, verify: bool = True,
         spans.append((start, length))
         pos = start + length + 4
     return spans
+
+
+def record_views(buf, spans: list[tuple[int, int]]) -> list[memoryview]:
+    """Zero-copy ``memoryview`` slices of ``buf`` over payload ``spans``.
+
+    The view-producing half of the ingest fast path: one root view, one
+    slice per record, no payload copies.  LIFETIME CONTRACT — each view
+    pins the WHOLE shard buffer; holders must drop (or copy) their views
+    when the chunk that delivered them is released, or a few retained
+    records keep multi-MB buffers alive.  ``ingest`` enforces this in
+    debug mode (``TOS_INGEST_ZEROCOPY=debug``) by releasing delivered
+    views, making late access raise ``ValueError``.  Raw buffer slicing
+    of shard files is confined here and in ``dfutil`` by the
+    ``shard-io-discipline`` checker, so every view producer carries this
+    contract.
+    """
+    root = memoryview(buf)
+    return [root[off:off + length] for off, length in spans]
+
+
+def walk_record_bounds(path: str, span_bytes: int) -> list[tuple[int, int]]:
+    """Record-aligned ``(start, end)`` byte ranges of a PLAIN shard, each
+    covering ~``span_bytes`` of file (the last may be smaller).
+
+    The driver-side half of sub-shard work items: only record HEADERS are
+    read (12 bytes per record, seek past payloads), so splitting a
+    multi-GB shard costs header IO, not a full read — and no CRC work;
+    verification happens node-side when the range is actually read.
+    Raises :class:`RecordError` on a truncated header/record so a corrupt
+    shard fails at enumeration, not mid-train.  Must not be called on
+    gzip shards (no byte-addressable record boundaries exist there — see
+    ``is_gzipped_shard``).
+    """
+    if span_bytes <= 0:
+        raise ValueError(f"span_bytes must be positive, got {span_bytes}")
+    size = os.path.getsize(path)
+    bounds: list[tuple[int, int]] = []
+    start = pos = 0
+    with open(path, "rb") as f:
+        while pos < size:
+            if pos + 12 > size:
+                raise RecordError(f"{path}: truncated header at offset {pos}")
+            f.seek(pos)
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                raise RecordError(f"{path}: truncated header at offset {pos}")
+            (length,) = _U64.unpack(hdr)
+            nxt = pos + 12 + length + 4
+            if nxt > size:
+                raise RecordError(f"{path}: truncated record at offset {pos}")
+            pos = nxt
+            if pos - start >= span_bytes:
+                bounds.append((start, pos))
+                start = pos
+    if pos > start:
+        bounds.append((start, pos))
+    return bounds
+
+
+def map_span_range(path: str, start: int = 0, end: int | None = None,
+                   verify: bool = True):
+    """mmap-backed ``(buffer, spans)`` for a record-aligned byte range of a
+    PLAIN shard (whole shard when ``end`` is None).
+
+    The zero-copy twin of :func:`read_span_range`: the buffer is a
+    ``memoryview`` over mapped pages, so the CRC scan and every record
+    view read the page cache DIRECTLY — no copy of the range into process
+    memory at all (``read()`` pays a full extra DRAM pass, which is what
+    caps multi-node ingest of one shard on bandwidth-tight hosts).  The
+    mapping lives exactly as long as the buffer/its views (refcounted);
+    the ingest lifetime contract (views valid until chunk release) is
+    unchanged.  Must not be used on gzip shards (caller probes first).
+    """
+    import mmap
+
+    size = os.path.getsize(path)
+    if end is None:
+        end = size
+    if not 0 <= start <= end <= size:
+        raise ValueError(f"invalid span range [{start}, {end}) for {path} "
+                         f"of size {size}")
+    if start == end:
+        return memoryview(b""), []
+    aligned = (start // mmap.ALLOCATIONGRANULARITY) * mmap.ALLOCATIONGRANULARITY
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), end - aligned, prot=mmap.PROT_READ,
+                       offset=aligned)
+    if hasattr(mm, "madvise"):
+        mm.madvise(mmap.MADV_SEQUENTIAL)
+    buf = memoryview(mm)[start - aligned:]
+    return buf, scan_record_spans(buf, verify,
+                                  name=f"{path}[{start}:{end}]")
+
+
+def map_record_spans(path: str, verify: bool = True):
+    """Whole-shard :func:`map_span_range` with the gzip probe folded into
+    the SAME open: the magic bytes are read off the mapped head, so the
+    default zero-copy read path costs one ``open()`` per shard (on remote
+    filesystems every extra open is a metadata round-trip).  Returns
+    ``(buf, spans)`` for plain shards, ``(None, None)`` for gzip shards
+    (no byte-addressable spans exist — the caller stream-decompresses).
+    """
+    import mmap
+
+    size = os.path.getsize(path)
+    if size == 0:
+        return memoryview(b""), []
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    if _is_gzip_shard(mm[:12]):
+        mm.close()
+        return None, None
+    if hasattr(mm, "madvise"):
+        mm.madvise(mmap.MADV_SEQUENTIAL)
+    buf = memoryview(mm)
+    return buf, scan_record_spans(buf, verify, name=path)
+
+
+def read_span_range(path: str, start: int, end: int, verify: bool = True
+                    ) -> tuple[bytes, list[tuple[int, int]]]:
+    """Buffer + payload spans for ONE record-aligned byte range of a plain
+    shard (a ``walk_record_bounds`` item): seek, one bounded read, one CRC
+    scan.  The node-side half of sub-shard work items — N nodes each read
+    their own range of the same multi-GB shard.  ``start``/``end`` MUST be
+    record boundaries (the scan raises :class:`RecordError` otherwise, so
+    a stale/corrupt range fails loudly rather than mis-framing)."""
+    if not 0 <= start < end:
+        raise ValueError(f"invalid span range [{start}, {end})")
+    with open(path, "rb") as f:
+        f.seek(start)
+        buf = f.read(end - start)
+    if len(buf) < end - start:
+        raise RecordError(f"{path}: span range [{start}, {end}) past EOF")
+    return buf, scan_record_spans(buf, verify,
+                                  name=f"{path}[{start}:{end}]")
 
 
 def read_record_spans(path: str, verify: bool = True) -> tuple[bytes, list[tuple[int, int]]]:

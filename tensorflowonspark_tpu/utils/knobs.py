@@ -84,6 +84,15 @@ _ALL = (
          "DIRECT-mode ingest: parallel shard-reader threads per node (the "
          "autotune ceiling; exact pool size when TOS_INGEST_AUTOTUNE=0; "
          "0 = synchronous in-consumer reads, zero pipeline threads)."),
+    Knob("TOS_INGEST_SPAN_BYTES", "int", "268435456 (256 MiB)",
+         "DIRECT-mode ingest: plain (non-gzip) shards larger than this "
+         "split into record-aligned sub-shard work items so N nodes "
+         "parallelize inside one multi-GB shard; 0 keeps shards whole."),
+    Knob("TOS_INGEST_ZEROCOPY", "str", "1",
+         "DIRECT-mode ingest zero-copy record views: 1 delivers records "
+         "as memoryview slices of the shard buffer (valid until the batch "
+         "retires), 0 restores bytes copies, 'debug' releases retired "
+         "batches' views so a retained view fails loudly."),
     Knob("TOS_MAX_PARTITION_ATTEMPTS", "int", "3",
          "Total feed attempts per partition (at-least-once ledger) before "
          "the job fails."),

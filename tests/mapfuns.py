@@ -488,11 +488,27 @@ def direct_record_counter(args, ctx):
             batch = feed.next_batch(args.get("batch_size", 16))
             if not batch:
                 continue
-            f.write("".join(rec.decode() + "\n" for rec in batch))
+            # zero-copy contract: records are memoryviews (plain shards)
+            # or bytes (gzip); str() handles both without retaining views
+            f.write("".join(str(rec, "utf-8") + "\n" for rec in batch))
             f.flush()
             n += len(batch)
     ctx.update_meta({f"records_inc{ctx.incarnation}": n,
                      "manifest": ctx.job_manifest()})
+
+
+def direct_fit_counter(args, ctx):
+    """DIRECT-mode pipeline train_fn: drain the ledger-driven ingest feed
+    and write this node's record count — the probe for the
+    ``TPUEstimator.fit`` DIRECT-onto-the-ledger satellite (``args`` is the
+    merged pipeline Namespace, so params arrive attribute-style)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    n = 0
+    while not feed.should_stop():
+        n += len(feed.next_batch(args.get("batch_size", 16)))
+    out = os.path.join(args.log_dir, f"fit_count_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(str(n))
 
 
 def pipelined_consensus_consumer(args, ctx):

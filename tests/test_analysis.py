@@ -409,6 +409,39 @@ def test_shard_io_fires_on_path_read_bytes():
     assert len(found) == 1 and "read_bytes" in found[0].anchor
 
 
+def test_shard_io_fires_on_raw_shard_buffer_views():
+    found = lint(
+        """
+        import mmap
+        def f(shard_buf, shard_file):
+            v = memoryview(shard_buf)[12:4096]
+            m = mmap.mmap(shard_file.fileno(), 0)
+            return v, m
+        """, f"{PKG}/somemod.py", "shard-io-discipline")
+    assert len(found) == 2
+    assert all("lifetime contract" in f.message for f in found)
+
+
+def test_shard_io_view_rule_confined_to_codec_homes():
+    """tfrecord.py/dfutil.py own view production; ingest/ is exempt from
+    the OPEN rule (it reads via the codecs) but NOT the view rule — its
+    views must come from tfrecord.record_views, not ad-hoc slicing."""
+    src = """
+        def f(shard_buf):
+            return memoryview(shard_buf)[0:100]
+        """
+    assert lint(src, f"{PKG}/tfrecord.py", "shard-io-discipline") == []
+    assert lint(src, f"{PKG}/dfutil.py", "shard-io-discipline") == []
+    assert len(lint(src, f"{PKG}/ingest/readers.py",
+                    "shard-io-discipline")) == 1
+    # non-shard-named buffers stay quiet everywhere (lexical heuristic)
+    assert lint(
+        """
+        def f(frame_buf):
+            return memoryview(frame_buf)[4:]
+        """, f"{PKG}/somemod.py", "shard-io-discipline") == []
+
+
 def test_shard_io_quiet_in_sanctioned_homes_and_on_non_shard_io():
     src = """
         def f(shard_path):

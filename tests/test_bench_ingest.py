@@ -35,3 +35,30 @@ def test_bench_quick_table_shape(tmp_path):
         assert results[f"{mode}_scaling"] == [1.0]
     out = bench_ingest.markdown_table(results)
     assert "direct" in out and "streaming" in out
+
+
+def test_bench_zerocopy_and_columnar_compare_quick(tmp_path):
+    """Round-12 compare machinery: both legs run, exact counts hold (the
+    runners raise on any mismatch), and the speedup fields are present."""
+    zc = bench_ingest.bench_zerocopy(quick=True, data_dir=str(tmp_path / "zc"))
+    assert zc["zerocopy"]["mb_per_s"] > 0 and zc["bytescopy"]["mb_per_s"] > 0
+    assert "speedup_pct" in zc
+    col = bench_ingest.bench_columnar(quick=True,
+                                      data_dir=str(tmp_path / "col"))
+    assert col["columnar"]["mb_per_s"] > 0 and col["rowdecode"]["mb_per_s"] > 0
+    assert col["speedup_x"] > 0
+
+
+def test_bench_bigshard_scenario_quick(tmp_path):
+    """Single-large-shard scenario: the shard actually splits into span
+    items and every cell (split N=1/N=2, whole-shard N=2) keeps exact
+    counts."""
+    big = bench_ingest.bench_bigshard(quick=True,
+                                      data_dir=str(tmp_path / "big"))
+    assert big["num_items"] > 1              # the shard went out as spans
+    assert big["n2_whole_shard"]["num_items"] == 1
+    assert big["n1"]["mb_per_s"] > 0 and big["n2"]["mb_per_s"] > 0
+    zc = {"zerocopy": big["n1"], "bytescopy": big["n1"], "speedup_pct": 0.0}
+    col = {"columnar": big["n1"], "rowdecode": big["n1"], "speedup_x": 1.0}
+    out = bench_ingest.markdown_round12(zc, col, big)
+    assert "single-large-shard" in out

@@ -196,3 +196,74 @@ class TestPackChunk:
         got = unpack_items(pickle.loads(body, buffers=views))
         assert got == rows
         assert all(type(r) is bytes for r in got)
+
+
+class TestZeroCopyIngestPacking:
+    def test_memoryview_rows_pack_out_of_band(self):
+        """Ingest zero-copy record views pack like bytes rows: one buffer
+        per row, no payload copy on the send side, real bytes rebuilt on
+        the receive side (and a protocol-4 peer still round-trips)."""
+        import pickle
+
+        from tensorflowonspark_tpu.data import pack_chunk, unpack_items
+
+        blob = b"\x07" * 5000 + b"\x01" * 5000
+        root = memoryview(blob)
+        rows = [root[0:5000], root[5000:10000]]
+        packed = pack_chunk(rows)
+        assert packed is not None
+        bufs = []
+        body = pickle.dumps(packed, protocol=5, buffer_callback=bufs.append)
+        assert len(bufs) == 2
+        got = unpack_items(pickle.loads(body,
+                                        buffers=[b.raw() for b in bufs]))
+        assert got == [bytes(r) for r in rows]
+        got4 = unpack_items(pickle.loads(pickle.dumps(packed, protocol=4)))
+        assert got4 == [bytes(r) for r in rows]
+        # sub-threshold views stay unpacked (same rule as bytes rows)
+        assert pack_chunk([root[0:100], root[100:200]]) is None
+
+    def test_column_chunk_packs_as_columns_layout(self, tmp_path):
+        """A dfutil.ColumnChunk packs whole: 'columns' layout, one
+        out-of-band buffer per numeric column, rows identical after the
+        wire."""
+        import pickle
+
+        from tensorflowonspark_tpu import dfutil
+        from tensorflowonspark_tpu.data import pack_chunk, unpack_items
+
+        rows = [{"x": [float(i), i + 1.0], "y": i} for i in range(8)]
+        schema = dfutil.infer_schema(rows[0])
+        cols, counts = dfutil.records_to_columns(
+            [dfutil.to_example(r, schema) for r in rows], schema)
+        chunk = dfutil.ColumnChunk.from_schema(cols, counts, schema)
+        packed = pack_chunk(chunk)
+        assert packed is not None and packed.layout == "columns"
+        assert len(packed) == 8
+        bufs = []
+        body = pickle.dumps(packed, protocol=5, buffer_callback=bufs.append)
+        assert bufs  # columns went out-of-band
+        back = pickle.loads(body, buffers=[b.raw() for b in bufs])
+        assert unpack_items(back) == chunk.rows()
+        # a bare ColumnChunk fed as a pre-packed item also unpacks
+        assert unpack_items(chunk) == chunk.rows()
+
+    def test_sub_threshold_views_materialize_for_the_wire(self):
+        """Zero-copy records below the out-of-band threshold fall out of
+        packing — they must become bytes at the fallback, not crash
+        pickle deep in the transport (memoryview is unpicklable)."""
+        import pickle
+
+        from tensorflowonspark_tpu.data import materialize_views, pack_chunk
+
+        root = memoryview(b"q" * 2048)
+        small = [root[0:512], root[512:1024]]
+        assert pack_chunk(small) is None  # sub-threshold: unpacked
+        fixed = materialize_views(small)
+        assert fixed == [b"q" * 512] * 2
+        assert pickle.dumps(fixed)  # wire-safe now
+        # tuple/dict rows carrying views fix too; clean lists pass through
+        assert materialize_views([(root[0:4], 1)]) == [(b"qqqq", 1)]
+        assert materialize_views([{"a": root[0:4]}]) == [{"a": b"qqqq"}]
+        clean = [b"x", (1, 2), {"a": 3}]
+        assert materialize_views(clean) is clean

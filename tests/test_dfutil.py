@@ -328,3 +328,111 @@ def test_rows_to_columns_round_trip():
     assert dfutil.rows_to_columns([{"a": 1}, {"b": 2}]) is None
     assert dfutil.rows_to_columns([1, 2]) is None
     assert dfutil.rows_to_columns([]) is None
+
+
+def test_decode_span_columns_matches_read_shard_columns(tmp_path):
+    """The buffer-level columnar decoder is read_shard_columns on a span
+    subset: full-span decode matches, and a window decodes just its
+    records (the ingest reader's per-chunk call shape)."""
+    from tensorflowonspark_tpu import tfrecord
+
+    ds = PartitionedDataset.from_iterable(rows() * 3, 1)
+    schema = dfutil.save_as_tfrecords(ds, str(tmp_path / "out"))
+    shard = dfutil.shard_files(str(tmp_path / "out"))[0]
+    whole_cols, whole_counts = dfutil.read_shard_columns(shard, schema)
+    buf, spans = tfrecord.read_record_spans(shard)
+    cols, counts = dfutil.decode_span_columns(buf, spans, schema)
+    np.testing.assert_array_equal(cols["feat"], whole_cols["feat"])
+    assert cols["name"] == whole_cols["name"]
+    window_cols, window_counts = dfutil.decode_span_columns(
+        buf, spans[2:5], schema)
+    np.testing.assert_array_equal(window_cols["feat"],
+                                  whole_cols["feat"][4:10])
+    assert len(window_counts["label"]) == 3
+
+
+def test_column_chunk_slice_rows_and_pickle(tmp_path):
+    """ColumnChunk: zero-copy batch slices whose representation follows
+    the SCHEMA declaration (ragged columns always (values, counts) pairs
+    — even for a chunk whose counts happen to be uniform), row expansion
+    matching from_example shapes, and a protocol-5 pickle round trip
+    shipping columns out-of-band."""
+    import pickle
+
+    r = [{"feat": [0.5 * i, 1.0 * i], "label": i, "name": f"n{i}"}
+         for i in range(6)]
+    r[3]["feat"] = [9.0]  # make 'feat' genuinely ragged
+    schema = dfutil.infer_schema(r[0])
+    schema["feat"].width = None  # declare the raggedness
+    cols, counts = dfutil.records_to_columns(
+        [dfutil.to_example(x, schema) for x in r], schema)
+    chunk = dfutil.ColumnChunk.from_schema(cols, counts, schema)
+    assert len(chunk) == 6
+    s = chunk.slice(1, 3)
+    assert s["label"].tolist() == [1, 2]          # int64 scalar column
+    assert s["name"] == ["n1", "n2"]              # str scalar column
+    vals, cnts = s["feat"]                        # ragged -> values+counts
+    assert vals.tolist() == [0.5, 1.0, 1.0, 2.0] and cnts.tolist() == [2, 2]
+    # representation STABILITY: a window whose counts happen to be
+    # uniform must come back in the same ragged form, not an ndarray
+    vals01, cnts01 = chunk.slice(0, 3)["feat"]
+    assert cnts01.tolist() == [2, 2, 2]
+    back = chunk.rows()
+    assert back[0]["label"] == 0 and back[0]["name"] == "n0"
+    assert back[3]["feat"] == [9.0]
+    bufs = []
+    blob = pickle.dumps(chunk, protocol=5, buffer_callback=bufs.append)
+    assert bufs  # numeric columns travelled out-of-band
+    again = pickle.loads(blob, buffers=[b.raw() for b in bufs])
+    assert again.rows() == back
+
+
+def test_column_chunk_declared_width_violation_fails_loudly(tmp_path):
+    """Data that violates its column's declared fixed width must raise a
+    ValueError naming the column — a silent per-chunk representation
+    switch would mis-frame batches mid-feed."""
+    r = [{"feat": [0.5, 1.0], "label": i} for i in range(4)]
+    schema = dfutil.infer_schema(r[0])  # feat declares width=2
+    assert schema["feat"].width == 2
+    r[2]["feat"] = [9.0]  # on-disk record breaks the declaration
+    cols, counts = dfutil.records_to_columns(
+        [dfutil.to_example(x, schema) for x in r], schema)
+    chunk = dfutil.ColumnChunk.from_schema(cols, counts, schema)
+    with pytest.raises(ValueError, match="feat.*width=None"):
+        chunk.slice(0, 2)
+
+
+def test_column_chunk_fixed_width_slice(tmp_path):
+    ds = PartitionedDataset.from_iterable(rows(), 1)
+    schema = dfutil.save_as_tfrecords(ds, str(tmp_path / "out"))
+    shard = dfutil.shard_files(str(tmp_path / "out"))[0]
+    cols, counts = dfutil.read_shard_columns(shard, schema)
+    chunk = dfutil.ColumnChunk.from_schema(cols, counts, schema)
+    s = chunk.slice(0, 2)
+    assert s["feat"].shape == (2, 2)  # fixed-width k=2 reshapes [n, k]
+    # the slice is a VIEW of the chunk's contiguous buffer, not a copy
+    assert s["feat"].base is not None
+
+
+def test_save_relaxes_inferred_width_on_ragged_data(tmp_path):
+    """An auto-inferred fixed width must demote to ragged (None) when any
+    written row disagrees — otherwise the stored schema promises a
+    columnar layout the shards break mid-train.  A caller-provided
+    schema keeps its own declarations."""
+    rows_ragged = [{"x": [1.0, 2.0], "y": 1}, {"x": [3.0], "y": 2}]
+    ds = PartitionedDataset.from_iterable(rows_ragged, 1)
+    schema = dfutil.save_as_tfrecords(ds, str(tmp_path / "out"))
+    assert schema["x"].width is None          # relaxed while writing
+    stored = dfutil.read_schema(str(tmp_path / "out"))
+    assert stored["x"].width is None
+    # columnar read of the ragged dataset works (pair representation)
+    shard = dfutil.shard_files(str(tmp_path / "out"))[0]
+    cols, counts = dfutil.read_shard_columns(shard, stored)
+    chunk = dfutil.ColumnChunk.from_schema(cols, counts, stored)
+    vals, cnts = chunk.slice(0, 2)["x"]
+    assert cnts.tolist() == [2, 1] and vals.tolist() == [1.0, 2.0, 3.0]
+    # uniform data keeps its inferred width
+    uniform = [{"x": [1.0, 2.0]}, {"x": [3.0, 4.0]}]
+    s2 = dfutil.save_as_tfrecords(
+        PartitionedDataset.from_iterable(uniform, 1), str(tmp_path / "u"))
+    assert s2["x"].width == 2

@@ -17,16 +17,20 @@ import time
 import pytest
 
 from tensorflowonspark_tpu import cluster as tcluster
+from tensorflowonspark_tpu import dfutil
 from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.data import PartitionedDataset
 from tensorflowonspark_tpu.feeding import FeedQueues
 from tensorflowonspark_tpu.ingest import (
     IngestFeed,
     ReaderPipeline,
     ShardReadError,
+    ShardSpan,
     enumerate_shards,
     prefetch_iterator,
     shards_as_partitioned,
+    split_shards,
 )
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition
 
@@ -74,11 +78,14 @@ def test_pipeline_exact_records_across_modes(tmp_path, readers):
         pipe.submit(p)
     pipe.close()
     got = _drain(pipe)
-    assert sorted(r.decode() for r in got) == sorted(ids)
+    # zero-copy default: plain-shard records are memoryviews, gzip bytes
+    assert sorted(str(r, "utf-8") for r in got) == sorted(ids)
 
 
 def test_pipeline_decode_runs_in_readers(tmp_path):
     paths, _ = _write_shards(tmp_path, 2, 30)
+    # decode callables keep their bytes contract even under zero-copy
+    # (views would crash every decoder written against bytes)
     pipe = ReaderPipeline(readers=2, autotune=False,
                           decode=lambda rec: rec.decode().split("-r")[1])
     for p in paths:
@@ -172,6 +179,183 @@ def test_prefetch_iterator_order_and_error():
         next(it)
 
 
+# -- zero-copy record views (TOS_INGEST_ZEROCOPY) -----------------------------
+
+
+def test_zerocopy_views_default_bytes_optout(tmp_path):
+    """Default: plain-shard records are memoryview slices (no copy), gzip
+    records bytes (streamed); zerocopy=False restores bytes everywhere."""
+    paths, ids = _write_shards(tmp_path, 2, 20, gzip_last=True)
+    pipe = ReaderPipeline(readers=1, autotune=False)
+    for p in paths:
+        pipe.submit(p)
+    pipe.close()
+    got = _drain(pipe)
+    assert sorted(str(r, "utf-8") for r in got) == sorted(ids)
+    kinds = {str(r, "utf-8").split("-")[0]: type(r) for r in got}
+    assert kinds["s0"] is memoryview  # plain shard: zero-copy view
+    assert kinds["s1"] is bytes       # gzip shard: streamed bytes
+
+    pipe = ReaderPipeline(readers=1, autotune=False, zerocopy=False)
+    for p in paths:
+        pipe.submit(p)
+    pipe.close()
+    assert all(type(r) is bytes for r in _drain(pipe))
+
+
+def test_zerocopy_debug_release_fails_loudly(tmp_path):
+    """The decode contract, enforced: in debug mode a view retained past
+    its batch's retirement (the next next_batch call) raises ValueError at
+    first touch, while the batch in hand stays valid."""
+    paths, _ = _write_shards(tmp_path, 1, 30)
+    queues = FeedQueues(("input",))
+    _feed_paths(queues, paths)
+    feed = IngestFeed(queues, readers=1, zerocopy="debug")
+    first = feed.next_batch(10)
+    assert type(first[0]) is memoryview
+    assert bytes(first[0])  # the batch in hand is always safe
+    retained = first[0]
+    second = feed.next_batch(10)
+    assert bytes(second[0])  # current batch valid
+    with pytest.raises(ValueError):
+        bytes(retained)  # released view: loud, not a silent buffer pin
+
+
+# -- columnar Example decode (schema mode) ------------------------------------
+
+
+def _write_example_shards(root, gzip_out: bool = False):
+    """Two schema'd Example shards (x float[2], y int64 scalar, name str);
+    returns (dir, schema, expected y values in row order)."""
+    rows = [{"x": [float(i), i + 0.5], "y": i, "name": f"r{i}"}
+            for i in range(24)]
+    data = PartitionedDataset.from_partitions([rows[:12], rows[12:]])
+    out = str(root / "exdata")
+    schema = dfutil.save_as_tfrecords(
+        data, out, compression="gzip" if gzip_out else None)
+    return out, schema, list(range(24))
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_columnar_schema_batches(tmp_path, gz):
+    """schema= routes shards through the columnar decoder: batches are
+    {column: contiguous-buffer views} dicts — float columns [n, k]
+    float32, int64 scalars [n], str columns lists — and gzip shards
+    (which cannot span-decode) produce IDENTICAL batches via the
+    streaming accumulator."""
+    import numpy as np
+
+    out, schema, ys = _write_example_shards(tmp_path, gzip_out=gz)
+    queues = FeedQueues(("input",))
+    _feed_paths(queues, dfutil.shard_files(out))
+    feed = IngestFeed(queues, readers=1, schema=schema)
+    got_y, got_x, got_names = [], [], []
+    while not feed.should_stop():
+        batch = feed.next_batch(7)
+        if not batch:
+            continue
+        assert set(batch) == {"x", "y", "name"}
+        assert batch["x"].dtype == np.float32 and batch["x"].ndim == 2
+        assert batch["x"].shape[1] == 2
+        assert batch["y"].dtype == np.int64
+        got_y.extend(batch["y"].tolist())
+        got_x.extend(batch["x"][:, 0].tolist())
+        got_names.extend(batch["name"])
+    assert sorted(got_y) == ys
+    assert sorted(got_names) == sorted(f"r{i}" for i in ys)
+    assert got_x == [float(y) for y in got_y]  # row alignment across columns
+    assert queues.partitions_consumed("input") == 2  # watermark exact
+
+
+def test_columnar_input_mapping_renames(tmp_path):
+    out, schema, _ = _write_example_shards(tmp_path)
+    queues = FeedQueues(("input",))
+    _feed_paths(queues, dfutil.shard_files(out))
+    feed = IngestFeed(queues, readers=1, schema=schema,
+                      input_mapping={"x": "features", "y": "label"})
+    batch = feed.next_batch(6)
+    assert set(batch) == {"features", "label"}
+    assert batch["features"].shape == (6, 2)
+
+
+def test_columnar_schema_excludes_decode(tmp_path):
+    queues = FeedQueues(("input",))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        IngestFeed(queues, readers=1, schema=dfutil.Schema([]),
+                   decode=lambda r: r)
+
+
+# -- sub-shard span work items ------------------------------------------------
+
+
+def _write_padded_shard(root, name: str, shard_id: int, recs: int,
+                        pad: int = 90) -> tuple[str, set[str]]:
+    """One shard of ``recs`` ~100-byte records with unique prefixes."""
+    records = [f"s{shard_id}-r{i}-".encode() + b"x" * pad for i in range(recs)]
+    path = os.path.join(str(root), name)
+    tfrecord.write_records(path, records)
+    return path, {r.decode() for r in records}
+
+
+def test_split_shards_spans_and_gzip_fallback(tmp_path):
+    """Large plain shards split into contiguous record-aligned ShardSpan
+    items; gzip shards — regardless of size — stay whole-path items (a
+    gzip stream cannot be span-split or view-sliced from a seekable
+    buffer), and small shards stay whole."""
+    big, big_ids = _write_padded_shard(tmp_path, "part-00000", 0, 64)
+    small, small_ids = _write_padded_shard(tmp_path, "part-00001", 1, 3)
+    gz = os.path.join(str(tmp_path), "part-00002.gz")
+    gz_records = [f"s2-r{i}-".encode() + b"x" * 90 for i in range(64)]
+    tfrecord.write_records(gz, gz_records, compression="gzip")
+
+    items = split_shards([big, small, gz], span_bytes=1000)
+    spans = [i for i in items if isinstance(i, ShardSpan)]
+    assert spans and all(s.path == big for s in spans)
+    assert small in items and gz in items  # whole items, no splitting
+    # spans tile the big shard: contiguous, start at 0, end at file size
+    assert spans[0].start == 0 and spans[-1].end == os.path.getsize(big)
+    assert all(a.end == b.start for a, b in zip(spans, spans[1:]))
+
+    # the reader pipeline delivers exactly the full record set from the
+    # mixed item list (span ranges + whole shards)
+    pipe = ReaderPipeline(readers=2, autotune=False, chunk_records=8)
+    for it in items:
+        pipe.submit(it)
+    pipe.close()
+    got = sorted(str(r, "utf-8") for r in _drain(pipe))
+    assert got == sorted(big_ids | small_ids | {r.decode() for r in gz_records})
+
+
+def test_shards_as_partitioned_span_items(tmp_path):
+    big, _ = _write_padded_shard(tmp_path, "part-00000", 0, 64)
+    ds = shards_as_partitioned(str(tmp_path), span_bytes=1000)
+    assert ds.num_partitions > 1  # one file became many span partitions
+    items = [it for p in range(ds.num_partitions) for it in ds.iter_partition(p)]
+    assert all(isinstance(it, ShardSpan) for it in items)
+    # span_bytes=0 disables splitting
+    assert shards_as_partitioned(str(tmp_path), span_bytes=0).num_partitions == 1
+
+
+def test_ingest_feed_span_items_watermark(tmp_path):
+    """ShardSpan items flow the ledger feed exactly like paths: per-item
+    EndPartition keys, exact coverage, exact consumption watermark."""
+    big, ids = _write_padded_shard(tmp_path, "part-00000", 0, 48)
+    items = split_shards([big], span_bytes=800)
+    assert len(items) > 2
+    queues = FeedQueues(("input",))
+    q = queues.get_queue("input")
+    for i, item in enumerate(items):
+        q.put(item)
+        q.put(EndPartition(key=(0, i)))
+    q.put(EndOfFeed())
+    feed = IngestFeed(queues, readers=2)
+    seen: list[str] = []
+    while not feed.should_stop():
+        seen.extend(str(r, "utf-8") for r in feed.next_batch(13))
+    assert sorted(seen) == sorted(ids)
+    assert queues.partitions_consumed("input") == len(items)
+
+
 # -- IngestFeed: watermark contract over the path feed ------------------------
 
 
@@ -191,7 +375,9 @@ def test_ingest_feed_drains_and_reports_watermark(tmp_path):
     feed = IngestFeed(queues, readers=2)
     seen = []
     while not feed.should_stop():
-        seen.extend(feed.next_batch(37))
+        # copy out of the zero-copy views before the batch retires (the
+        # decode contract: views are released when the next batch arrives)
+        seen.extend(bytes(r) for r in feed.next_batch(37))
     assert sorted(r.decode() for r in seen) == sorted(ids)
     # every partition fully handed over -> watermark exact
     assert queues.partitions_consumed("input") == 4
@@ -326,6 +512,7 @@ def test_direct_train_e2e_exact_accounting(tmp_path, monkeypatch):
     # the driver-published manifest reached the nodes
     manifests = [m.get("manifest") for m in metas.values() if m.get("manifest")]
     assert manifests and manifests[0]["num_shards"] == 6
+    assert manifests[0]["num_items"] == 6  # tiny shards: no sub-shard split
     assert manifests[0]["num_epochs"] == 1
     # both nodes participated (ledger round-robin over 6 shard partitions)
     counts = [m.get("records_inc0", 0) for m in metas.values()]
@@ -343,6 +530,54 @@ def test_streaming_cluster_rejects_path_train(tmp_path):
             cluster.train(str(tmp_path / "somewhere"))
     finally:
         cluster.shutdown(timeout=60.0)
+
+
+@pytest.mark.chaos
+def test_direct_kill_mid_subshard_rereads_lost_span(tmp_path, monkeypatch):
+    """Chaos at SPAN granularity: ONE large plain shard split into
+    sub-shard items across 2 nodes, SIGKILL one node mid-consumption.
+    The ledger must re-assign exactly the dead node's unread/unconsumed
+    span ranges (to the survivor or the supervised restart) and the
+    epoch's DISTINCT record coverage must come out exact — duplicates
+    allowed (a re-fed span is re-read from its start offset), loss
+    never."""
+    monkeypatch.setenv("TOS_SHM_RING", "0")  # a SIGKILL leaves rings wedged
+    monkeypatch.setenv("TOS_DEAD_NODE_TIMEOUT", "4")
+    monkeypatch.setenv("TOS_RESTART_BACKOFF_BASE", "0.2")
+    monkeypatch.setenv("TOS_INGEST_SPAN_BYTES", "2048")
+    shard_dir = tmp_path / "shards"
+    os.makedirs(shard_dir)
+    path, ids = _write_padded_shard(shard_dir, "part-00000", 0, 240)
+    assert len(split_shards([path], span_bytes=2048)) >= 8  # real span fan-out
+    per_node_env = [{}, {"TOS_FAULTINJECT": "kill:after_batches=3,incarnation=0"}]
+    cluster = tcluster.run(
+        mapfuns.direct_record_counter,
+        {"out_dir": str(tmp_path), "batch_size": 16},
+        num_executors=2,
+        input_mode=tcluster.InputMode.DIRECT,
+        heartbeat_interval=0.5,
+        per_node_env=per_node_env,
+        log_dir=str(tmp_path / "logs"),
+        reservation_timeout=120.0,
+        elastic=True,
+    )
+    cluster.train(str(shard_dir), num_epochs=1)
+    metas = {m["executor_id"]: m for m in cluster.coordinator.cluster_info()}
+    victims = [eid for eid, m in metas.items() if m.get("incarnation") == 1]
+    assert len(victims) == 1, metas
+    cluster.shutdown(timeout=120.0)
+    assert cluster.coordinator.errors() == []  # recovered, not fatal
+    # manifests publish when the feeds EOF at shutdown
+    metas = {m["executor_id"]: m for m in cluster.coordinator.cluster_info()}
+    manifests = [m.get("manifest") for m in metas.values() if m.get("manifest")]
+    assert manifests and manifests[0]["num_shards"] == 1
+    assert manifests[0]["num_items"] >= 8  # the shard went out as spans
+    seen: list[str] = []
+    for f in tmp_path.glob("seen_*.txt"):
+        seen.extend(x for x in f.read_text().split() if x)
+    # distinct coverage exact: the lost span ranges were re-read in full
+    assert set(seen) == ids
+    assert len(seen) >= len(ids)  # at-least-once may duplicate, never lose
 
 
 @pytest.mark.chaos
