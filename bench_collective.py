@@ -1,0 +1,239 @@
+"""Cross-host collective bench: bucketed ring vs naive gather-broadcast.
+
+The number this bench exists to produce (ISSUE 12 / BENCH_r13): aggregate
+all-reduce bandwidth over the cluster wire for the two algorithms, same
+run, same payload, same node processes —
+
+- ``ring``: the production path (``TOS_COLLECTIVE_ALGO=ring``) — chunked
+  ring all-reduce (reduce-scatter + all-gather), every node moving
+  ``2(W-1)/W x N`` bytes with all links active concurrently, transfers
+  sub-chunked at ``TOS_COLLECTIVE_BUCKET_BYTES`` so accumulate overlaps
+  the wire.
+- ``naive``: the gather-broadcast control — every rank ships its whole
+  array to rank 0, the root reduces and ships the result back.  Identical
+  TOTAL wire bytes at any world size (``2(W-1) x N``), but the root
+  serializes them: first the whole gather, then the whole broadcast, one
+  peer at a time.
+
+Every round VERIFIES the reduced result exactly (rank r contributes
+``full(r+1)``; the result must equal ``W(W+1)/2`` everywhere) — a wrong
+sum fails the bench, it never just skews the MB/s.
+
+Topology per node process: ``FeedQueues + DataServer`` (the collective
+wire rides the node's data port, exactly as in a real cluster),
+``CoordinatorClient`` registration for identity, and a ``CollectiveGroup``
+formed through the driver's ``CoordinatorServer`` rendezvous.
+
+Headline metric: ``agg_mb_per_s = W x payload_bytes / t`` — every node
+reduced its full payload in ``t`` seconds (t = the slowest node's wall
+time for the round, medianed over rounds).  The acceptance ratio is
+``ring_vs_naive_x = naive_t / ring_t`` on >= 64 MB payloads.
+
+Usage::
+
+    python bench_collective.py                      # full run, markdown + JSON
+    python bench_collective.py --quick              # tiny sizes (CI smoke)
+    python bench_collective.py --json BENCH_r13.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import statistics
+import time
+
+import numpy as np
+
+ALGOS = ("ring", "naive")
+
+
+def _node_main(conn, coord_addr, authkey: bytes, world: int,
+               payload_elems: int, repeats: int, algos, bucket_bytes: int,
+               timeout: float) -> None:
+    """Child process: one collective member — DataServer (the peer wire) +
+    coordinator registration + a CollectiveGroup running timed rounds."""
+    from tensorflowonspark_tpu.collective import CollectiveGroup
+    from tensorflowonspark_tpu.coordinator import CoordinatorClient
+    from tensorflowonspark_tpu.dataserver import DataServer
+    from tensorflowonspark_tpu.feeding import FeedQueues
+
+    queues = FeedQueues(capacity=8)
+    server = DataServer(queues, authkey, feed_timeout=timeout)
+    port = server.start()
+    client = CoordinatorClient(coord_addr, authkey=authkey)
+    ident = client.register({"host": "127.0.0.1", "data_port": port,
+                             "pid": os.getpid()})
+    eid = int(ident["executor_id"])
+    client.set_identity(eid, int(ident.get("incarnation", 0)))
+    group = CollectiveGroup(coord_addr, authkey, eid, world,
+                            "127.0.0.1", port, name="bench", timeout=timeout,
+                            bucket_bytes=bucket_bytes)
+    try:
+        group.form()
+        arr = np.full(payload_elems, float(eid + 1), np.float32)
+        expect = np.float32(world * (world + 1) / 2.0)
+        results: dict[str, list[float]] = {}
+        for algo in algos:
+            # warmup: one FULL-SIZE untimed round — dials + attaches, page
+            # faults on the big buffers, and TCP buffer/congestion-window
+            # autotune growth (which small writes take several rounds to
+            # finish; measured: the first 1-2 cold rounds run ~2x slow)
+            group.all_reduce(arr, algo=algo)
+            times = []
+            for _ in range(repeats):
+                group.barrier()  # rounds start aligned across nodes
+                t0 = time.perf_counter()
+                out = group.all_reduce(arr, algo=algo)
+                dt = time.perf_counter() - t0
+                if out.shape != arr.shape or not np.all(out == expect):
+                    raise RuntimeError(
+                        f"{algo}: corrupted all-reduce result on rank "
+                        f"{group.rank} (expected {expect})")
+                times.append(dt)
+            results[algo] = times
+        conn.send({"eid": eid, "rank": group.rank, "results": results})
+    except BaseException as e:  # noqa: BLE001 - surfaced driver-side
+        conn.send(RuntimeError(f"bench node failed: {e!r}"))
+        raise
+    finally:
+        group.close()
+        client.close()
+        server.stop()
+
+
+def bench_once(world: int, payload_bytes: int, repeats: int,
+               algos=ALGOS, bucket_bytes: int = 4 << 20,
+               timeout: float = 120.0) -> dict:
+    """One measured comparison: ``world`` real node processes, both
+    algorithms, same payload, interleaved in one run."""
+    from tensorflowonspark_tpu.coordinator import CoordinatorServer
+
+    payload_elems = max(1, payload_bytes // 4)
+    payload_bytes = payload_elems * 4
+    authkey = b"bench-collective"
+    coord = CoordinatorServer(world, authkey=authkey)
+    addr = coord.start("127.0.0.1")
+    ctx = mp.get_context("fork")
+    procs, conns = [], []
+    try:
+        for _ in range(world):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_node_main,
+                            args=(child, addr, authkey, world, payload_elems,
+                                  repeats, tuple(algos), bucket_bytes,
+                                  timeout),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+            conns.append(parent)
+        reports = []
+        for conn in conns:
+            got = conn.recv()
+            if isinstance(got, BaseException):
+                raise got
+            reports.append(got)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        coord.stop()
+    out: dict = {"world": world, "payload_mb": round(payload_bytes / 1e6, 2),
+                 "payload_bytes": payload_bytes, "repeats": repeats,
+                 "bucket_bytes": bucket_bytes}
+    for algo in algos:
+        # a round is only done when its SLOWEST node is done
+        round_times = [max(r["results"][algo][i] for r in reports)
+                       for i in range(repeats)]
+        t = statistics.median(round_times)
+        out[algo] = {
+            "seconds_median": round(t, 4),
+            "round_seconds": [round(x, 4) for x in round_times],
+            # W nodes each had their N-byte array fully reduced in t
+            "agg_mb_per_s": round(world * payload_bytes / t / 1e6, 1),
+            # the classic algbw framing (payload / time)
+            "alg_mb_per_s": round(payload_bytes / t / 1e6, 1),
+        }
+    if "ring" in out and "naive" in out:
+        out["ring_vs_naive_x"] = round(
+            out["naive"]["seconds_median"] / out["ring"]["seconds_median"], 2)
+    return out
+
+
+def bench(quick: bool = False, world: int | None = None,
+          payload_mb: float | None = None, repeats: int | None = None,
+          bucket_bytes: int = 4 << 20) -> dict:
+    world = world or (2 if quick else 3)
+    payload_bytes = int((payload_mb or (1 if quick else 64)) * (1 << 20))
+    repeats = repeats or (2 if quick else 5)
+    return bench_once(world, payload_bytes, repeats,
+                      bucket_bytes=bucket_bytes)
+
+
+def bench_r13(repeats: int = 7, payload_mb: float = 64.0,
+              bucket_bytes: int = 4 << 20) -> dict:
+    """The BENCH_r13 scenario: the acceptance comparison at W=3 (ring's
+    bandwidth optimality vs the root-serialized control) plus the W=2
+    minimal ring as context — both on the same >=64 MB payload."""
+    payload = int(payload_mb * (1 << 20))
+    return {
+        "schema": "tos-bench-collective-r13",
+        "w3": bench_once(3, payload, repeats, bucket_bytes=bucket_bytes),
+        "w2": bench_once(2, payload, repeats, bucket_bytes=bucket_bytes),
+    }
+
+
+def markdown_table(result: dict) -> str:
+    rows = [
+        "| algo | median s | agg MB/s | algbw MB/s |",
+        "|---|---|---|---|",
+    ]
+    for algo in ALGOS:
+        if algo not in result:
+            continue
+        r = result[algo]
+        rows.append(f"| {algo} | {r['seconds_median']} | {r['agg_mb_per_s']} "
+                    f"| {r['alg_mb_per_s']} |")
+    rows.append("")
+    rows.append(f"W={result['world']}, payload {result['payload_mb']} MB, "
+                f"bucket {result['bucket_bytes'] >> 20} MiB, "
+                f"ring vs naive: x{result.get('ring_vs_naive_x', '?')}")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny payload, 2 nodes (CI smoke)")
+    ap.add_argument("--world", type=int, default=None)
+    ap.add_argument("--payload-mb", type=float, default=None)
+    ap.add_argument("--repeats", type=int, default=None)
+    ap.add_argument("--bucket-mb", type=float, default=4.0)
+    ap.add_argument("--scenario", choices=("single", "r13"), default="single")
+    ap.add_argument("--json", default=None, help="write results JSON here")
+    args = ap.parse_args(argv)
+    if args.scenario == "r13":
+        result = bench_r13(repeats=args.repeats or 7,
+                           payload_mb=args.payload_mb or 64.0,
+                           bucket_bytes=int(args.bucket_mb * (1 << 20)))
+        for key in ("w3", "w2"):
+            print(f"### {key}")
+            print(markdown_table(result[key]))
+            print()
+    else:
+        result = bench(quick=args.quick, world=args.world,
+                       payload_mb=args.payload_mb, repeats=args.repeats,
+                       bucket_bytes=int(args.bucket_mb * (1 << 20)))
+        print(markdown_table(result))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -199,30 +199,58 @@ class KnobDisciplineChecker(Checker):
 
 # The zero-copy socket primitives are easy to get subtly wrong (short
 # writes, IOV_MAX, partial recv_into) — they live behind utils/net.py
-# helpers (sendmsg_all / recv_exact_into) and the framing layer in
-# dataserver.py, and NOWHERE else.
+# helpers (sendmsg_all / recv_exact_into), the framing layer in
+# dataserver.py, and the collective peer transport built on that layer.
 _ZEROCOPY_IO_NAMES = frozenset({"sendmsg", "recv_into"})
-_ZEROCOPY_IO_ALLOWED = ("utils/net.py", "dataserver.py")
+_ZEROCOPY_IO_ALLOWED = ("utils/net.py", "dataserver.py",
+                        "collective/transport.py")
+# Collective peer sockets (dials AND listeners) are confined to
+# collective/transport.py: a peer channel outside it would sidestep the
+# generation stamping / broken-connection abort cascade that makes a ring
+# death recoverable — group.py/ops.py speak in ranks and tags only.
+_COLLECTIVE_SOCKET_CALLS = frozenset({
+    "connect_with_backoff", "bound_socket", "create_connection", "socket",
+})
+_COLLECTIVE_TRANSPORT = "collective/transport.py"
 
 
 @register_checker
 class DialDisciplineChecker(Checker):
     """Raw socket dials are forbidden outside utils/net.py; raw zero-copy
     socket I/O (sendmsg/recv_into) is confined to utils/net.py +
-    dataserver.py."""
+    dataserver.py + collective/transport.py; and within ``collective/``,
+    peer sockets of ANY kind are confined to transport.py."""
 
     id = "dial-discipline"
     hint = ("dial via utils.net.connect_with_backoff (bounded retries + "
             "jitter); a one-shot connect fails hard across restart windows")
+    collective_hint = ("open/dial collective peer sockets only in "
+                       "collective/transport.py — it owns generation "
+                       "stamping and the broken-connection abort cascade; "
+                       "group.py/ops.py must go through PeerTransport")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         if mod.path.endswith("utils/net.py"):
             return
         io_exempt = mod.path.endswith(_ZEROCOPY_IO_ALLOWED)
+        collective_confined = ("/collective/" in mod.path
+                               and not mod.path.endswith(_COLLECTIVE_TRANSPORT))
         for node, scope in _scoped_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if mod.imports.qualify(node.func) == "socket.create_connection":
+            fq = mod.imports.qualify(node.func)
+            if collective_confined:
+                name = (fq.rsplit(".", 1)[-1] if fq
+                        else _terminal_name(node.func))
+                if name in _COLLECTIVE_SOCKET_CALLS:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"collective peer socket ({name}()) outside "
+                        "collective/transport.py bypasses the transport's "
+                        "generation fencing and abort cascade",
+                        self.collective_hint, f"{_qual(scope)}@{name}")
+                    continue
+            if fq == "socket.create_connection":
                 yield Finding(
                     self.id, mod.path, node.lineno,
                     "raw socket.create_connection bypasses connect_with_backoff",
@@ -232,10 +260,11 @@ class DialDisciplineChecker(Checker):
                 if name in _ZEROCOPY_IO_NAMES:
                     yield Finding(
                         self.id, mod.path, node.lineno,
-                        f"raw {name}() outside utils/net.py/dataserver.py — "
-                        "scatter-gather/preallocated-buffer socket I/O must "
-                        "go through the shared helpers (short writes, "
-                        "IOV_MAX, partial reads are handled there once)",
+                        f"raw {name}() outside utils/net.py/dataserver.py/"
+                        "collective/transport.py — scatter-gather/"
+                        "preallocated-buffer socket I/O must go through the "
+                        "shared helpers (short writes, IOV_MAX, partial "
+                        "reads are handled there once)",
                         "use utils.net.sendmsg_all / recv_exact_into (or the "
                         "dataserver framing layer)",
                         f"{_qual(scope)}@{name}")
@@ -356,6 +385,9 @@ class ShardIODisciplineChecker(Checker):
 _THREADED_BASENAMES = frozenset({
     "coordinator.py", "cluster.py", "dataserver.py", "supervisor.py",
     "node.py", "feeding.py",
+    # the collective layer: dataserver connection threads deliver into the
+    # inbox while the comm executor sends and the map_fun thread reforms
+    "transport.py", "group.py", "ops.py",
     # the online-serving subsystem is thread-per-replica + flush/watch
     # threads throughout — same race classes, same discipline
     "gateway.py", "batcher.py", "router.py",

@@ -757,7 +757,8 @@ class TPUCluster:
     def train(self, data: Any, num_epochs: int = 1, qname: str = "input",
               shuffle_seed: int | None = None,
               num_partitions: int | None = None,
-              span_bytes: int | None = None) -> None:
+              span_bytes: int | None = None,
+              mode: str = "async") -> None:
         """Feed the workers for ``num_epochs`` epochs; blocks until all
         partitions are consumed (or nodes report 'terminating').
 
@@ -794,7 +795,34 @@ class TPUCluster:
         (seed+epoch, deterministic) — the between-epochs shuffle the
         reference inherited from Spark/tf.data file shuffling; in DIRECT
         mode this is a between-epochs *shard* (work-item) shuffle.
+
+        ``mode="sync"`` declares CROSS-HOST SYNCHRONOUS training (the
+        MultiWorkerMirrored/ParameterServer replacement at cluster scope):
+        the published job manifest carries a ``sync`` block (collective
+        group name + world size) so every node's map_fun forms the
+        :meth:`NodeContext.collective_group` and exchanges gradients each
+        step — a compile-once jit step with a bucketed ring all-reduce via
+        ``parallel.dp.make_train_step(cross_host_grad_fn=group.grad_fn())``,
+        with the lockstep batch iterator keeping per-host step counts
+        aligned (``make_batch_iterator(lockstep=True)``).  The feed
+        machinery itself is identical to the default ``"async"``
+        (driver-fed, at-least-once) mode; with ``elastic=True`` a node
+        death mid-collective aborts the poisoned round at the group's
+        generation barrier, the supervised restart rejoins, and training
+        resumes from the synced step.
         """
+        if mode not in ("async", "sync"):
+            raise ValueError(
+                f"train mode must be 'async' or 'sync', got {mode!r}")
+        # Published for map_funs either way the data travels: the sync block
+        # is the map_fun-facing DECLARATION of this train call's mode (one
+        # map_fun body can branch on it) with the intended group name and
+        # the driver's feedable count at publish time.  Group formation
+        # itself defaults to the registration-time num_data_nodes
+        # (ctx.collective_group) — after a resize the two can differ; see
+        # the collectives caveat on resize().
+        sync_block = ({"group": "train", "world": len(self._feedable_ids())}
+                      if mode == "sync" else None)
         if self.input_mode == InputMode.DIRECT:
             from tensorflowonspark_tpu.ingest import shards_as_partitioned
 
@@ -821,7 +849,7 @@ class TPUCluster:
                 num_items = len(items)
                 dataset = shards_as_partitioned(items, num_partitions,
                                                 span_bytes=0)
-            self.coordinator.set_manifest({
+            manifest = {
                 "kind": "tfrecord_shards", "qname": qname,
                 "num_shards": num_shards,
                 # work items the ledger feeds: == num_shards unless large
@@ -829,8 +857,12 @@ class TPUCluster:
                 "num_items": num_items,
                 "num_partitions": dataset.num_partitions,
                 "num_epochs": num_epochs,
+                "mode": mode,
                 "spec": str(data) if isinstance(data, (str, os.PathLike)) else None,
-            })
+            }
+            if sync_block is not None:
+                manifest["sync"] = sync_block
+            self.coordinator.set_manifest(manifest)
         else:
             if isinstance(data, (str, os.PathLike)):
                 raise RuntimeError(
@@ -841,6 +873,15 @@ class TPUCluster:
                     "input_mode=InputMode.DIRECT (reference: "
                     "InputMode.TENSORFLOW) for node-side shard ingestion")
             dataset = as_partitioned(data, default_partitions=len(self._feed_ids))
+            if sync_block is not None:
+                # STREAMING publishes a manifest only when sync mode needs
+                # one (async streaming kept its no-manifest behavior)
+                self.coordinator.set_manifest({
+                    "kind": "stream_rows", "qname": qname,
+                    "num_partitions": dataset.num_partitions,
+                    "num_epochs": num_epochs, "mode": mode,
+                    "sync": sync_block,
+                })
         # One view per epoch (identity, or the seeded between-epochs shuffle);
         # precomputed so a re-fed partition sees the same epoch ordering.
         views = [dataset if shuffle_seed is None
@@ -1319,10 +1360,12 @@ class TPUCluster:
 
         Collectives caveat: default-group ``ctx.barrier()``/reduces track
         the live membership (retired slots leave the participant count),
-        but ``group="data"`` collectives and ``ctx.all_done`` consensus use
-        each node's registration-time ``num_data_nodes`` and do NOT follow
-        resizes yet — the ROADMAP's cross-host-collectives item owns the
-        generation-barrier rejoin design for SPMD workloads.
+        but ``group="data"`` collectives, ``ctx.all_done`` consensus, and
+        tensor-plane :meth:`NodeContext.collective_group` worlds use each
+        node's registration-time ``num_data_nodes`` and do NOT follow
+        resizes.  Collective groups survive same-world elastic RESTARTS
+        (the generation-barrier rejoin, ``collective/group.py``); a
+        *changed* world size still means a new ``train()`` call.
         """
         if num_nodes < 1:
             raise ValueError("resize needs num_nodes >= 1")

@@ -1,0 +1,14 @@
+"""Cross-host collective communication over the cluster wire (ISSUE 12).
+
+The subsystem behind ``cluster.train(..., mode="sync")``: coordinator-
+brokered group formation with generation fencing (``group.py``), ring /
+naive collective algorithms on numpy arrays (``ops.py``), and the peer
+transport that rides each node's existing zero-copy data-plane port
+(``transport.py``).  See the README "Synchronous training" section for
+the map_fun-level walkthrough.
+"""
+
+from tensorflowonspark_tpu.collective.group import CollectiveGroup
+from tensorflowonspark_tpu.collective.transport import CollectiveAborted
+
+__all__ = ["CollectiveAborted", "CollectiveGroup"]

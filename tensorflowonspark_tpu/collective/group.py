@@ -1,0 +1,467 @@
+"""Coordinator-brokered collective group: rendezvous, generations, buckets.
+
+:class:`CollectiveGroup` is the map_fun-facing handle for cross-host
+synchronous training (the ROADMAP's ParameterServer/MWMS replacement at
+cluster scope): the coordinator's rendezvous assigns **rank / world-size /
+peer endpoints** and a monotone **generation** (the ``form`` reduce kind in
+``coordinator.py``); the tensor plane then runs rank-to-rank over each
+node's existing data-plane port (``transport.py``), and every gradient
+exchange is a bucketed ring all-reduce (``ops.py``).
+
+Elastic rejoin — the generation barrier
+---------------------------------------
+
+A peer death poisons the in-flight round: every member observes
+:class:`CollectiveAborted` within milliseconds (broken-connection cascade,
+see ``transport.py``) instead of deadlocking.  Recovery is then symmetric
+for survivors and the supervised replacement:
+
+1. everyone calls :meth:`reform` — a fresh coordinator rendezvous at a
+   bumped generation (the **generation barrier**: nothing proceeds until
+   the full world, including the restarted slot, stands at it; the
+   coordinator's incarnation fencing keeps the dead predecessor out);
+2. everyone calls :meth:`sync_state` — the member that voted the highest
+   step (a survivor holding live state, or everyone's checkpoint step
+   after a full restart) broadcasts its state tree, and the group resumes
+   from that step in lockstep.
+
+Stale traffic from the aborted generation — late kernel-buffer flushes, a
+fenced zombie's chunks — carries the old generation stamp and is dropped.
+
+Gradient buckets
+----------------
+
+:meth:`all_reduce_tree` (and the :func:`grad_fn` hook it powers, consumed
+by ``parallel.dp.make_train_step(cross_host_grad_fn=...)``) packs pytree
+leaves into ``TOS_COLLECTIVE_BUCKET_BYTES`` buckets per dtype and flushes
+each bucket to the comm thread AS IT FILLS: bucket *k*'s ring all-reduce
+runs concurrently with the host-side device_get/pack of bucket *k+1*, so
+communication overlaps the tail of backprop instead of serializing after
+it.
+
+Known limitation: a fenced-but-alive zombie (dropped heartbeats, not a
+death) is excluded from every coordinator op but can still move bytes on
+the peer plane until the next reform bumps the generation; SIGKILL-style
+deaths (the chaos-tested path) never reach that window.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.collective import ops as cops
+from tensorflowonspark_tpu.collective.transport import (
+    CollectiveAborted,
+    PeerTransport,
+)
+from tensorflowonspark_tpu.coordinator import CoordinatorClient
+from tensorflowonspark_tpu.telemetry import trace as ttrace
+from tensorflowonspark_tpu.utils.envtune import env_float, env_int, env_str
+
+logger = logging.getLogger(__name__)
+
+
+def _plan_buckets(leaves: list, bucket_bytes: int) -> list[list[int]]:
+    """Greedy leaf->bucket assignment: consecutive same-dtype leaves pack
+    into buckets of at most ``bucket_bytes`` (an oversized leaf is its own
+    bucket — ring chunking bounds its frames).  Consecutive-only on
+    purpose: packing preserves tree order, so unpacking is pure slicing."""
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, leaf in enumerate(leaves):
+        # shape/dtype attributes only: np.asarray here would force a
+        # device->host transfer during PLANNING, before any overlap begins
+        dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        shape = tuple(getattr(leaf, "shape", ()))
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if cur and (dtype != cur_dtype or cur_bytes + nbytes > bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+class CollectiveGroup:
+    """One node's membership in a named cluster-wide collective group.
+
+    Construct via :meth:`NodeContext.collective_group` inside a map_fun (or
+    directly with explicit endpoints — the bench does), then :meth:`form`
+    before the first collective.  All collectives are SPMD calls: every
+    member must make the same sequence of calls with compatible arrays.
+    Thread contract: the constructing thread owns the public API; the
+    internal comm executor serializes all peer I/O.
+    """
+
+    def __init__(self, coordinator_addr: tuple[str, int], authkey: bytes,
+                 executor_id: int, world: int, host: str, data_port: int,
+                 name: str = "train", incarnation: int = 0,
+                 timeout: float | None = None,
+                 bucket_bytes: int | None = None):
+        if world < 1:
+            raise ValueError("collective group needs world >= 1")
+        self.name = name
+        self.executor_id = int(executor_id)
+        self.world = int(world)
+        self.incarnation = int(incarnation)
+        self._host = host
+        self._data_port = int(data_port)
+        self._timeout = (env_float("TOS_COLLECTIVE_TIMEOUT", 120.0)
+                         if timeout is None else float(timeout))
+        self._algo = env_str("TOS_COLLECTIVE_ALGO", "ring")
+        self._bucket_bytes = (env_int("TOS_COLLECTIVE_BUCKET_BYTES", 4 << 20)
+                              if bucket_bytes is None else int(bucket_bytes))
+        # Dedicated control-plane connection: formation rendezvous can block
+        # through a whole restart window and must never wedge the node's
+        # main client (heartbeats already have their own).
+        self._client = CoordinatorClient(coordinator_addr, authkey=authkey)
+        self._client.set_identity(self.executor_id, self.incarnation)
+        self._tp = PeerTransport(name, authkey, self._timeout)
+        # ONE comm thread: serializes all peer I/O (sends never interleave)
+        # and is the overlap engine — bucket k reduces here while the caller
+        # packs bucket k+1.
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"collective-{name}")
+        self.rank = -1
+        self.generation = 0
+        self.agreed_step = 0
+        self._root_rank = 0
+        self._members: list[dict] = []
+        self._seq = 0
+        self._closed = False
+
+    # -- formation / the generation barrier -----------------------------------
+
+    def form(self, resume_step: int = 0, timeout: float | None = None) -> int:
+        """Rendezvous with every member at a fresh generation; returns the
+        group's agreed resume step (the max of all members' votes — a
+        survivor's live step, or the checkpoint step after a cold start).
+
+        Retries through coordinator-side aborts: a rendezvous generation
+        poisoned by a death declaration (or by one member timing out while
+        the restarted slot is still booting) is simply re-entered until the
+        full world stands at the barrier or ``timeout`` expires.
+        """
+        if self._closed:
+            raise CollectiveAborted(f"collective group {self.name!r} is closed")
+        budget = self._timeout if timeout is None else float(timeout)
+        deadline = time.monotonic() + budget
+        me = {"eid": self.executor_id, "host": self._host,
+              "port": self._data_port, "gen": self.generation + 1,
+              "step": int(resume_step), "incarnation": self.incarnation}
+        t0 = time.monotonic()
+        last_err: Exception | None = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise CollectiveAborted(
+                    f"collective group {self.name!r} did not form within "
+                    f"{budget:.0f}s (world {self.world}): {last_err}")
+            try:
+                result = self._client.collective_form(
+                    f"cg.{self.name}.form", me, count=self.world,
+                    timeout=min(10.0, max(1.0, remaining)))
+                break
+            except RuntimeError as e:
+                # peer-abort / slice timeout / death-declaration abort:
+                # re-enter the barrier (the restarted slot may still be
+                # riding out its supervisor backoff)
+                last_err = e
+                time.sleep(0.2)
+        members = result["members"]
+        ranks = [int(m["eid"]) for m in members]
+        if self.executor_id not in ranks:
+            raise CollectiveAborted(
+                f"formation of {self.name!r} completed without this node "
+                f"(executor {self.executor_id} not in {ranks})")
+        self.rank = ranks.index(self.executor_id)
+        self.generation = int(result["generation"])
+        self.agreed_step = int(result["step"])
+        # state root: the lowest rank among the highest-step voters — the
+        # member whose state tree sync_state broadcasts
+        steps = [int(m.get("step", 0)) for m in members]
+        self._root_rank = steps.index(max(steps))
+        self._members = members
+        self._seq = 0  # SPMD op counter restarts with the generation
+        self._tp.configure(self.generation, self.rank, members)
+        telemetry.gauge("collective.generation").set(self.generation)
+        telemetry.counter("collective.formations_total").inc()
+        telemetry.histogram("collective.form_secs").observe(
+            time.monotonic() - t0)
+        ttrace.event("collective_form", group=self.name,
+                     generation=self.generation, rank=self.rank,
+                     world=self.world, step=self.agreed_step)
+        logger.info("collective group %r formed: generation %d, rank %d/%d, "
+                    "agreed step %d", self.name, self.generation, self.rank,
+                    self.world, self.agreed_step)
+        return self.agreed_step
+
+    def reform(self, resume_step: int = 0,
+               timeout: float | None = None) -> int:
+        """Re-form after an aborted round (peer death / timeout): poison the
+        current generation, DRAIN the comm thread, and stand at the next
+        generation barrier.  Survivors pass their live step; a restarted
+        node passes its checkpoint step (0 when it has none) —
+        :meth:`sync_state` then levels everyone.
+
+        The drain matters: a straggler bucket flight still running on the
+        comm thread would otherwise race the reconfigure — its sends would
+        pick up the NEW generation and rank table, and with ``_seq`` reset
+        at formation its stale chunks could collide with a fresh round's
+        ``(generation, seq, tag)`` keys.  Poisoning first makes the
+        straggler fail within milliseconds, so the drain is cheap."""
+        self._tp.poison_generation()
+        sentinel = self._exec.submit(lambda: None)
+        try:
+            # single comm worker: this resolves only after every previously
+            # submitted flight finished (poisoned, so promptly)
+            sentinel.result(timeout=self._timeout + 30.0)
+        except concurrent.futures.TimeoutError:
+            raise CollectiveAborted(
+                "comm thread did not drain after poisoning the aborted "
+                "generation; cannot safely re-form") from None
+        telemetry.counter("collective.reforms_total").inc()
+        return self.form(resume_step=resume_step, timeout=timeout)
+
+    # -- collectives -----------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _run(self, label: str, seq: int, fn):
+        """Execute one collective op on the comm thread and account for it;
+        a :class:`CollectiveAborted` tears the peer channels down so the
+        abort cascades to every member before their timeouts expire."""
+        t0 = time.monotonic()
+        fut = self._exec.submit(fn)
+        try:
+            # backstop only: the op's own recv/socket timeouts fire first
+            out = fut.result(timeout=2.0 * self._timeout + 30.0)
+        except concurrent.futures.TimeoutError:
+            self._abort_round(label, seq)
+            raise CollectiveAborted(
+                f"collective {label} (seq {seq}) wedged past "
+                f"{2.0 * self._timeout + 30.0:.0f}s") from None
+        except Exception:
+            # ANY failure poisons the round — not just CollectiveAborted: a
+            # programming error (shape mismatch in the accumulate, a bad
+            # dtype) on one member must still cascade the abort to its
+            # peers, or they sit out the full collective timeout blind
+            self._abort_round(label, seq)
+            raise
+        dur = time.monotonic() - t0
+        telemetry.counter("collective.rounds_total").inc()
+        telemetry.histogram(f"collective.{label}_secs").observe(dur)
+        ttrace.record_span("collective.round", ttrace.sample(), None,
+                           t0, dur, {"op": label, "seq": seq,
+                                     "gen": self.generation})
+        return out
+
+    def _abort_round(self, label: str, seq: int) -> None:
+        """Poison the current generation (local waiters + peer cascade) and
+        meter/record the abort."""
+        self._tp.poison_generation()
+        telemetry.counter("collective.aborts_total").inc()
+        ttrace.event("collective_abort", group=self.name,
+                     generation=self.generation, op=label, seq=seq)
+
+    def all_reduce(self, arr, average: bool = False,
+                   algo: str | None = None) -> np.ndarray:
+        """Element-wise sum (or mean) of ``arr`` across the group."""
+        seq = self._next_seq()
+        algo = algo or self._algo
+        bb = self._bucket_bytes
+        return self._run("all_reduce", seq,
+                         lambda: cops.all_reduce(self._tp, arr, seq=seq,
+                                                 bucket_bytes=bb, algo=algo,
+                                                 average=average))
+
+    def reduce_scatter(self, arr, average: bool = False) -> tuple[int, np.ndarray]:
+        seq = self._next_seq()
+        bb = self._bucket_bytes
+        return self._run("reduce_scatter", seq,
+                         lambda: cops.reduce_scatter(self._tp, arr, seq=seq,
+                                                     bucket_bytes=bb,
+                                                     average=average))
+
+    def all_gather(self, arr) -> list[np.ndarray]:
+        seq = self._next_seq()
+        return self._run("all_gather", seq,
+                         lambda: cops.all_gather(self._tp, arr, seq=seq))
+
+    def broadcast(self, arr=None, root: int = 0) -> np.ndarray:
+        seq = self._next_seq()
+        bb = self._bucket_bytes
+        return self._run("broadcast", seq,
+                         lambda: cops.broadcast(self._tp, arr, seq=seq,
+                                                root=root, bucket_bytes=bb))
+
+    def barrier(self, timeout: float | None = None) -> None:
+        """Control-plane barrier scoped to this group's world (generation-
+        stamped name, so a stale member can never satisfy a live one)."""
+        self._client.barrier(
+            f"cg.{self.name}.g{self.generation}.b{self._next_seq()}",
+            self.executor_id,
+            timeout=self._timeout if timeout is None else timeout,
+            count=self.world)
+
+    # -- gradient buckets (the dp.make_train_step hook) ------------------------
+
+    def all_reduce_tree(self, tree, average: bool = True,
+                        bucket_bytes: int | None = None,
+                        algo: str | None = None):
+        """Bucketed cross-host all-reduce of a pytree (gradients).
+
+        Leaves pack into per-dtype buckets of ``bucket_bytes``; each bucket
+        is submitted to the comm thread AS IT IS PACKED, so bucket *k*'s
+        ring all-reduce overlaps the host conversion (device_get) of bucket
+        *k+1* — the communication/backprop overlap of the sync-training
+        design, at host granularity.  Returns a tree of numpy arrays with
+        the input structure (the jitted apply step re-places them).
+        """
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        if not leaves:
+            return tree
+        bb = self._bucket_bytes if bucket_bytes is None else int(bucket_bytes)
+        algo = algo or self._algo
+        buckets = _plan_buckets(leaves, bb)
+        t0 = time.monotonic()
+        flights = []
+        for bucket in buckets:
+            # host materialization (device->host for jax leaves) happens
+            # HERE, on the caller's thread, while previous buckets reduce
+            # on the comm thread
+            arrs = [np.ascontiguousarray(np.asarray(leaves[i]).reshape(-1))
+                    for i in bucket]
+            packed = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+            seq = self._next_seq()
+            fut = self._exec.submit(cops.all_reduce, self._tp, packed,
+                                    seq=seq, bucket_bytes=bb, algo=algo,
+                                    average=average)
+            flights.append((bucket, fut))
+        out_leaves: list = list(leaves)
+        try:
+            for bucket, fut in flights:
+                packed = fut.result(timeout=2.0 * self._timeout + 30.0)
+                off = 0
+                for i in bucket:
+                    shape = tuple(getattr(leaves[i], "shape", ()))
+                    n = int(np.prod(shape, dtype=np.int64))
+                    out_leaves[i] = np.asarray(packed[off:off + n]).reshape(shape)
+                    off += n
+        except Exception as e:  # noqa: BLE001 - classified + re-raised below
+            # Poison FIRST (unblocks a bucket flight still running on the
+            # comm thread within milliseconds), then reap every flight —
+            # none may still be alive when a reform reconfigures ranks/seq,
+            # or its stale chunks could collide with the next round's keys.
+            self._abort_round("all_reduce_tree", self._seq)
+            for _, fut in flights:
+                fut.cancel()
+                if not fut.cancelled():
+                    try:
+                        fut.result(timeout=self._timeout + 30.0)
+                    except Exception:  # noqa: BLE001  # toslint: allow-silent(reaping poisoned flights; the primary error is re-raised below)
+                        pass
+            if isinstance(e, CollectiveAborted):
+                raise
+            if isinstance(e, concurrent.futures.TimeoutError):
+                raise CollectiveAborted(
+                    f"bucketed all-reduce wedged: {e}") from e
+            raise
+        dur = time.monotonic() - t0
+        telemetry.counter("collective.rounds_total").inc()
+        telemetry.histogram("collective.all_reduce_secs").observe(dur)
+        ttrace.record_span("collective.round", ttrace.sample(), None, t0,
+                           dur, {"op": "all_reduce_tree",
+                                 "buckets": len(buckets),
+                                 "gen": self.generation})
+        return jax.tree.unflatten(treedef, out_leaves)
+
+    def grad_fn(self, average: bool = True, bucket_bytes: int | None = None,
+                algo: str | None = None):
+        """The ``cross_host_grad_fn`` hook for
+        :func:`tensorflowonspark_tpu.parallel.dp.make_train_step`: averages
+        the per-host gradient tree across the group each step."""
+        def fn(grads):
+            return self.all_reduce_tree(grads, average=average,
+                                        bucket_bytes=bucket_bytes, algo=algo)
+        return fn
+
+    # -- post-reform state resync ----------------------------------------------
+
+    def broadcast_tree(self, tree, root: int | None = None):
+        """Broadcast a whole pytree from ``root`` (bucketed like
+        :meth:`all_reduce_tree`); non-root members' leaf VALUES are ignored
+        — only the tree structure (shapes/dtypes) must match."""
+        import jax
+
+        root = self._root_rank if root is None else int(root)
+        leaves, treedef = jax.tree.flatten(tree)
+        if not leaves or self.world == 1:
+            return tree
+        buckets = _plan_buckets(leaves, self._bucket_bytes)
+        out_leaves: list = list(leaves)
+        for bucket in buckets:
+            seq = self._next_seq()
+            if self.rank == root:
+                arrs = [np.ascontiguousarray(
+                    np.asarray(leaves[i]).reshape(-1)) for i in bucket]
+                packed = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+            else:
+                packed = None
+            got = self._run("broadcast", seq,
+                            lambda p=packed, s=seq: cops.broadcast(
+                                self._tp, p, seq=s, root=root,
+                                bucket_bytes=self._bucket_bytes))
+            off = 0
+            for i in bucket:
+                shape = tuple(getattr(leaves[i], "shape", ()))
+                n = int(np.prod(shape, dtype=np.int64))
+                out_leaves[i] = np.asarray(got).reshape(-1)[
+                    off:off + n].reshape(shape)
+                off += n
+        return jax.tree.unflatten(treedef, out_leaves)
+
+    def sync_state(self, tree, step: int):
+        """Level every member onto the agreed state after :meth:`form` /
+        :meth:`reform`: the highest-step voter broadcasts its state tree and
+        everyone adopts ``(its_tree, agreed_step)``.  A member already at
+        the agreed step keeps its own values bit-identical (it either IS
+        the root or receives the root's identical state)."""
+        if self.world == 1:
+            return tree, int(step)
+        synced = self.broadcast_tree(tree, root=self._root_rank)
+        if int(step) != self.agreed_step:
+            ttrace.event("collective_resync", group=self.name,
+                         generation=self.generation,
+                         from_step=int(step), to_step=self.agreed_step)
+            logger.info("collective group %r: resynced rank %d from step %d "
+                        "to step %d", self.name, self.rank, int(step),
+                        self.agreed_step)
+        return synced, self.agreed_step
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._exec.shutdown(wait=False)
+        self._tp.close()
+        try:
+            self._client.close()
+        except OSError:  # toslint: allow-silent(best-effort teardown of the dedicated control-plane connection)
+            pass

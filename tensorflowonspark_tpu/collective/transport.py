@@ -1,0 +1,381 @@
+"""Peer-to-peer collective transport over the existing zero-copy data wire.
+
+The tensor plane of the cross-host collectives (ISSUE 12 / the ROADMAP's
+"collectives over the cluster" item) rides the SAME wire the partition feed
+already uses: a peer dials its neighbor's :class:`~tensorflowonspark_tpu.
+dataserver.DataServer` port, passes the cluster HMAC handshake, and sends a
+``collective_attach`` op that turns the connection into a one-way stream of
+v2 (protocol-5, out-of-band-buffer) chunk frames — numpy gradient chunks
+scatter-gather straight from their own memory (``utils.net.sendmsg_all``)
+and land in preallocated receive buffers (``recv_into`` via the dataserver
+framing layer).  No second listener, no second auth scheme: a node's
+collective endpoint IS its registered ``data_port``.
+
+Confinement contract (enforced by the ``dial-discipline`` checker): every
+raw peer socket of the collective layer — the outbound dials here, the
+attach-side receive loops the dataserver hands over — lives in THIS module.
+``group.py``/``ops.py`` speak in ranks and tags only.
+
+Generation fencing: every frame is stamped with the group *generation*
+assigned by the coordinator rendezvous.  After an elastic restart re-forms
+the group (a new generation), frames from a poisoned round — a fenced
+zombie, a late buffer flush from a dead peer's socket — carry a stale
+generation and are dropped by the inbox instead of corrupting a live
+reduce; frames racing slightly AHEAD of a member's own reconfigure are
+buffered until it catches up (the coordinator reply reaches members at
+slightly different times).
+
+Failure semantics: a broken inbound connection poisons every pending and
+future receive from that peer *up to the generation the connection served*
+(:class:`CollectiveAborted`), so survivors abort a poisoned round within
+milliseconds of the death instead of riding out the full collective
+timeout.  Higher generations are untouched — the peer's replacement
+attaches with a fresh connection and a fresh generation.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import socket
+import threading
+import time
+
+from tensorflowonspark_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+class CollectiveAborted(RuntimeError):
+    """A collective round was poisoned (peer death, timeout, stale
+    generation): the caller must abandon the round, re-form the group at a
+    new generation barrier, and resync state before continuing."""
+
+
+# -- inbox registry (the dataserver's attach handler looks groups up here) ----
+
+_registry_lock = threading.Lock()
+_inboxes: dict[str, "CollectiveInbox"] = {}
+
+
+def register_inbox(name: str, inbox: "CollectiveInbox") -> None:
+    with _registry_lock:
+        if name in _inboxes:
+            raise RuntimeError(f"collective group {name!r} already registered "
+                               "in this process")
+        _inboxes[name] = inbox
+
+
+def unregister_inbox(name: str) -> None:
+    with _registry_lock:
+        _inboxes.pop(name, None)
+
+
+def lookup_inbox(name: str) -> "CollectiveInbox | None":
+    with _registry_lock:
+        return _inboxes.get(name)
+
+
+class CollectiveInbox:
+    """Per-group landing zone for inbound chunk frames.
+
+    Delivery threads are the dataserver's per-connection handlers (one per
+    attached peer); consumers are the group's collective ops.  Frames are
+    keyed ``(generation, src_rank, seq, tag)`` — ``seq`` is the group's
+    SPMD-consistent op counter (reset at each formation), ``tag`` the op's
+    internal message id — so out-of-order arrival across peers can never
+    mis-match a chunk.  Ahead-of-generation frames are buffered (a peer may
+    finish the formation rendezvous microseconds earlier); behind-generation
+    frames are dropped (fencing)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cond = threading.Condition()
+        self._frames: dict[tuple, collections.deque] = {}
+        # src rank -> highest generation a broken connection was serving:
+        # receives at or below it abort fast, above it are a NEW connection
+        self._failed: dict[int, int] = {}
+        self._generation = 0
+        self._closed = False
+
+    def advance_generation(self, generation: int) -> None:
+        """A new formation completed: drop every stale-generation frame and
+        failure record (fencing — a poisoned round's leftovers must never
+        feed a live one)."""
+        with self._cond:
+            self._generation = generation
+            self._frames = {k: v for k, v in self._frames.items()
+                            if k[0] >= generation}
+            self._failed = {s: g for s, g in self._failed.items()
+                            if g >= generation}
+            self._cond.notify_all()
+
+    def deliver(self, generation: int, src: int, seq: int, tag, payload) -> None:
+        with self._cond:
+            if self._closed or generation < self._generation:
+                return  # fenced: a stale round's frame
+            self._frames.setdefault((generation, src, seq, tag),
+                                    collections.deque()).append(payload)
+            self._cond.notify_all()
+
+    def fail_peer(self, src: int, generation: int) -> None:
+        """An inbound connection from ``src`` (serving up to ``generation``)
+        broke: poison matching receives so waiters abort immediately."""
+        with self._cond:
+            if generation >= self._failed.get(src, -1):
+                self._failed[src] = generation
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._frames.clear()
+            self._cond.notify_all()
+
+    def recv(self, generation: int, src: int, seq: int, tag,
+             timeout: float):
+        """Block for one frame; raises :class:`CollectiveAborted` on peer
+        failure, group close, or timeout (a silent peer must poison the
+        round, not wedge the trainer)."""
+        key = (generation, src, seq, tag)
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                q = self._frames.get(key)
+                if q:
+                    payload = q.popleft()
+                    if not q:
+                        del self._frames[key]
+                    return payload
+                if self._closed:
+                    raise CollectiveAborted(
+                        f"collective group {self.name!r} closed mid-receive")
+                if self._failed.get(src, -1) >= generation:
+                    raise CollectiveAborted(
+                        f"peer rank {src} lost its connection (generation "
+                        f"{generation}); round poisoned")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise CollectiveAborted(
+                        f"timed out after {timeout:.0f}s waiting for chunk "
+                        f"{tag!r} from rank {src} (generation {generation})")
+                self._cond.wait(min(0.5, remaining))
+
+
+# -- attach-side receive loop (runs on a dataserver connection thread) --------
+
+
+def attach_error(name: str) -> str | None:
+    """Validation half of the dataserver's ``collective_attach`` op: None
+    when the named group's inbox is live in this process."""
+    if lookup_inbox(name) is None:
+        return (f"no collective group {name!r} registered in this process "
+                "(peer attached before/after the group's lifetime)")
+    return None
+
+
+def serve_attached(conn: socket.socket, name: str, src_rank: int,
+                   generation: int) -> None:
+    """Receive loop for one attached peer connection: route chunk frames
+    into the group's inbox until the peer closes (or the group goes away).
+    Runs on the dataserver's per-connection thread — the reason sends from
+    a compute thread can never deadlock against a peer that is also mid-
+    send: every node's inbound wire is drained unconditionally."""
+    from tensorflowonspark_tpu.dataserver import _recv_frame
+
+    inbox = lookup_inbox(name)
+    if inbox is None:
+        return
+    rx_bytes = telemetry.counter("collective.rx_bytes")
+    rx_frames = telemetry.counter("collective.rx_frames")
+    last_gen = generation
+    try:
+        while True:
+            msg, _ = _recv_frame(conn)
+            if not (isinstance(msg, tuple) and msg and msg[0] == "cchunk"):
+                logger.warning("collective stream from rank %d carried a "
+                               "non-chunk frame %r; closing", src_rank,
+                               msg[0] if isinstance(msg, tuple) else msg)
+                return
+            _, gen, src, seq, tag, payload = msg
+            last_gen = max(last_gen, int(gen))
+            nbytes = getattr(payload, "nbytes", 0)
+            rx_bytes.inc(int(nbytes))
+            rx_frames.inc()
+            inbox.deliver(int(gen), int(src), int(seq), tag, payload)
+    except (ConnectionError, OSError, EOFError):
+        return
+    finally:
+        # the inbox this loop was feeding may have been replaced by a later
+        # group with the same name (close() then a fresh CollectiveGroup);
+        # poison only OURS, never the successor's
+        current = lookup_inbox(name)
+        if current is inbox:
+            inbox.fail_peer(src_rank, last_gen)
+
+
+# -- outbound peer channels ---------------------------------------------------
+
+
+class PeerTransport:
+    """One node's collective endpoint set: the registered inbox (inbound)
+    plus lazily-dialed outbound channels to peers, re-pointed at every
+    formation (``configure``).  Sends run on the group's single comm thread;
+    ``configure``/``close`` run on the map_fun thread — the small lock only
+    guards the shared maps, never any blocking I/O."""
+
+    def __init__(self, name: str, authkey: bytes, timeout: float):
+        self.name = name
+        self.authkey = authkey
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._conns: dict[int, socket.socket] = {}
+        self._members: list[dict] = []
+        self._generation = 0
+        self._rank = -1
+        self.inbox = CollectiveInbox(name)
+        register_inbox(name, self.inbox)
+
+    @property
+    def rank(self) -> int:
+        with self._lock:
+            return self._rank
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def world(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def configure(self, generation: int, rank: int, members: list[dict]) -> None:
+        """Adopt a completed formation: new generation, rank, and peer
+        endpoints.  Every cached outbound channel is dropped — a surviving
+        socket may point at a dead predecessor's port, and the new
+        generation must start from fresh dials."""
+        with self._lock:
+            self._generation = int(generation)
+            self._rank = int(rank)
+            self._members = [dict(m) for m in members]
+        self.drop_connections()
+        self.inbox.advance_generation(int(generation))
+
+    def drop_connections(self) -> None:
+        """Close every outbound channel (abort path + reconfigure): closing
+        our ends makes each peer's attach loop see EOF and poison its round
+        — the cascade that turns one death into a whole-ring abort within
+        milliseconds instead of a timeout per hop."""
+        with self._lock:
+            conns, self._conns = self._conns, {}
+        for sock in conns.values():
+            with contextlib.suppress(OSError):
+                sock.close()
+
+    def poison_generation(self) -> None:
+        """Abort the CURRENT generation locally and outward: every pending
+        (and future) receive of this generation fails immediately — so a
+        straggler op still running on the comm thread unblocks NOW, before
+        any reform can reconfigure ranks/seq under it — and the closed
+        outbound channels cascade the abort to every peer."""
+        with self._lock:
+            gen, world = self._generation, len(self._members)
+        for src in range(world):
+            self.inbox.fail_peer(src, gen)
+        self.drop_connections()
+
+    def _endpoint(self, dst: int) -> tuple[str, int]:
+        with self._lock:
+            if not 0 <= dst < len(self._members):
+                raise CollectiveAborted(
+                    f"rank {dst} is not a member of generation "
+                    f"{self._generation}")
+            m = self._members[dst]
+            return str(m["host"]), int(m["port"])
+
+    def _dial(self, dst: int) -> socket.socket:
+        from tensorflowonspark_tpu.dataserver import _recv, _send
+        from tensorflowonspark_tpu.utils.net import (
+            connect_with_backoff,
+            hmac_handshake_client,
+        )
+
+        host, port = self._endpoint(dst)
+        with self._lock:
+            gen, rank = self._generation, self._rank
+        sock = connect_with_backoff((host, port), timeout=self.timeout,
+                                    attempts=3)
+        try:
+            # bounded everything: a dead peer mid-handshake (or one whose
+            # kernel buffer backs up mid-reduce) must poison the round, not
+            # pin the comm thread forever
+            sock.settimeout(self.timeout)
+            if not hmac_handshake_client(sock, self.authkey):
+                raise CollectiveAborted(
+                    f"peer rank {dst} rejected the cluster authkey")
+            _send(sock, ("collective_attach", self.name, rank, gen), wire=2)
+            reply = _recv(sock)
+            if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
+                raise CollectiveAborted(
+                    f"peer rank {dst} refused collective attach: "
+                    f"{reply[1] if len(reply) > 1 else reply!r}")
+        except (OSError, ConnectionError, EOFError) as e:
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise CollectiveAborted(
+                f"could not attach to peer rank {dst} at {host}:{port}: {e}"
+            ) from e
+        except CollectiveAborted:
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise
+        telemetry.counter("collective.attaches_total").inc()
+        return sock
+
+    def send(self, dst: int, seq: int, tag, payload) -> None:
+        """Ship one chunk frame to ``dst`` (dialing lazily).  ``payload`` is
+        usually a numpy array — it travels as a protocol-5 out-of-band
+        buffer, scatter-gathered straight from its own memory — but any
+        picklable object works (broadcast headers)."""
+        from tensorflowonspark_tpu.dataserver import frame_parts
+        from tensorflowonspark_tpu.utils.net import sendmsg_all
+
+        with self._lock:
+            sock = self._conns.get(dst)
+            gen, rank = self._generation, self._rank
+        if sock is None:
+            sock = self._dial(dst)
+            with self._lock:
+                self._conns[dst] = sock
+        parts = frame_parts(("cchunk", gen, rank, seq, tag, payload), wire=2)
+        try:
+            sendmsg_all(sock, parts)
+        except (OSError, ConnectionError) as e:
+            with self._lock:
+                if self._conns.get(dst) is sock:
+                    del self._conns[dst]
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise CollectiveAborted(
+                f"send to peer rank {dst} failed mid-round: {e}") from e
+        telemetry.counter("collective.tx_bytes").inc(
+            int(getattr(payload, "nbytes", 0)))
+        telemetry.counter("collective.tx_frames").inc()
+
+    def recv(self, src: int, seq: int, tag, timeout: float | None = None):
+        with self._lock:
+            gen = self._generation
+        return self.inbox.recv(gen, src, seq, tag,
+                               self.timeout if timeout is None else timeout)
+
+    def close(self) -> None:
+        # unregister FIRST so a racing attach can't hand a connection to a
+        # closed inbox; late attach attempts get a clean refusal instead
+        current = lookup_inbox(self.name)
+        if current is self.inbox:
+            unregister_inbox(self.name)
+        self.inbox.close()
+        self.drop_connections()

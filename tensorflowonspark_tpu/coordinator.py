@@ -157,6 +157,19 @@ def _reduce(kind: str, values: list[Any]) -> Any:
         return max(values)
     if kind == "gather":
         return values
+    if kind == "form":
+        # Collective-group formation rendezvous (collective/group.py): each
+        # participant contributes {eid, host, port, gen, step}; everyone
+        # gets back the SAME membership view — eid-sorted members (rank =
+        # index of own eid), the max proposed generation (survivors propose
+        # cur+1, a cold joiner proposes 1 — max keeps generations monotone
+        # across any mix), and the max step vote (the resume point
+        # sync_state levels the group onto).  Arrival order never matters.
+        members = sorted((dict(v) for v in values),
+                         key=lambda m: int(m["eid"]))
+        return {"members": members,
+                "generation": max(int(m.get("gen", 1)) for m in members),
+                "step": max(int(m.get("step", 0)) for m in members)}
     raise ValueError(f"unknown reduce kind: {kind}")
 
 
@@ -1101,6 +1114,18 @@ class CoordinatorClient:
                 self._lock.release()
 
         return finish
+
+    def collective_form(self, name: str, member: dict, count: int,
+                        timeout: float = 300.0) -> dict:
+        """Collective-group formation rendezvous (the ``form`` reduce kind):
+        block until ``count`` members contributed their endpoint dicts, then
+        return the shared view ``{"members": [...eid-sorted...],
+        "generation": max, "step": max}``.  The caller's rank is the index
+        of its eid in ``members``.  Incarnation fencing applies: a fenced
+        zombie's join is rejected, so a dead predecessor can never occupy
+        its replacement's seat at the barrier."""
+        return self.reduce(name, dict(member), kind="form", timeout=timeout,
+                           count=count)
 
     def next_collective_name(self, prefix: str) -> str:
         """Locally-generated unique name; callers must use it SPMD-consistently."""

@@ -319,6 +319,27 @@ class DataServer:
                 return
             while True:
                 msg, was_vec = _recv_frame(conn)
+                if isinstance(msg, tuple) and msg \
+                        and msg[0] == "collective_attach":
+                    # Collective wire op: hand this (already-authenticated)
+                    # connection to the collective layer — after the ok
+                    # reply it becomes a one-way stream of ``cchunk``
+                    # frames a peer node's ring neighbor pumps gradient
+                    # chunks down (collective/transport.py).  The receive
+                    # loop runs on THIS connection thread, which is what
+                    # makes peer sends deadlock-free: every node's inbound
+                    # wire drains independently of its compute thread.
+                    from tensorflowonspark_tpu.collective import (
+                        transport as _ctransport,
+                    )
+
+                    err = _ctransport.attach_error(str(msg[1]))
+                    _send(conn, ("ok",) if err is None else ("err", err),
+                          wire=2 if was_vec else 1)
+                    if err is None:
+                        _ctransport.serve_attached(conn, str(msg[1]),
+                                                   int(msg[2]), int(msg[3]))
+                    return
                 try:
                     reply = self._handle(msg)
                 except faultinject.FaultInjected:

@@ -18,6 +18,11 @@ deterministically while its peers stay healthy):
 - ``sever`` — abruptly close the node's data-plane connection on the M-th
   data-carrying op (hook: ``dataserver.DataServer``).  Models a mid-partition
   socket loss with the node still healthy; the driver must requeue and refeed.
+- ``kill_collective`` — SIGKILL this node inside its N-th collective
+  all-reduce, after the first chunk exchange (hook: ``collective/ops.py``).
+  Models a preemption mid-gradient-exchange: partial chunks in flight,
+  peers blocked in the same round — survivors must abort at the generation
+  barrier and the restart must rejoin (``collective/group.py``).
 
 Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
 ``name:key=value,key=value`` —
@@ -68,7 +73,12 @@ class FaultPlan:
 
     _KEYS = {"kill": "after_batches",
              "drop_heartbeats": "count",
-             "sever": "after_data_ops"}
+             "sever": "after_data_ops",
+             # SIGKILL mid-collective: fires inside the Nth all-reduce, after
+             # the first chunk exchange (ops.py), so partial gradient chunks
+             # are genuinely in flight when the process dies — the round the
+             # generation-barrier rejoin must fence and survive
+             "kill_collective": "after_rounds"}
     # one-shot actions fire once when the counter REACHES the threshold;
     # windowed actions fire on EVERY call until the threshold is spent
     # (drop_heartbeats swallows the first K pings — one dropped ping would
@@ -184,23 +194,39 @@ def set_identity(executor_id: int, incarnation: int = 0) -> None:
         _PLAN.set_identity(executor_id, incarnation)
 
 
-def batch_consumed() -> None:
-    """Hook: one feed batch fully consumed by the map_fun.  ``kill`` fires
-    here with SIGKILL — the most brutal death available: no atexit, no
-    deregister, no flush, exactly what a preempted VM looks like.  The one
-    concession: the flight recorder dumps to disk first (a real preemption
-    grants no such grace, but the dump is the postmortem artifact the chaos
-    tests and operators read — and it costs microseconds)."""
-    if _PLAN is not None and _PLAN._tick("kill"):
-        logger.warning("fault injection: SIGKILL self (pid %d)", os.getpid())
-        if _FLIGHT_DUMP_PATH:
-            try:
-                from tensorflowonspark_tpu.telemetry import trace as ttrace
+def _sigkill_self() -> None:
+    """SIGKILL this process — the most brutal death available: no atexit,
+    no deregister, no flush, exactly what a preempted VM looks like.  The
+    one concession: the flight recorder dumps to disk first (a real
+    preemption grants no such grace, but the dump is the postmortem
+    artifact the chaos tests and operators read — and it costs
+    microseconds)."""
+    logger.warning("fault injection: SIGKILL self (pid %d)", os.getpid())
+    if _FLIGHT_DUMP_PATH:
+        try:
+            from tensorflowonspark_tpu.telemetry import trace as ttrace
 
-                ttrace.dump_flight(_FLIGHT_DUMP_PATH, node=_FLIGHT_DUMP_NODE)
-            except Exception:  # noqa: BLE001 - the kill must still fire
-                logger.warning("flight dump before kill failed", exc_info=True)
-        os.kill(os.getpid(), signal.SIGKILL)
+            ttrace.dump_flight(_FLIGHT_DUMP_PATH, node=_FLIGHT_DUMP_NODE)
+        except Exception:  # noqa: BLE001 - the kill must still fire
+            logger.warning("flight dump before kill failed", exc_info=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def batch_consumed() -> None:
+    """Hook: one feed batch fully consumed by the map_fun; ``kill`` fires
+    here with SIGKILL (see :func:`_sigkill_self`)."""
+    if _PLAN is not None and _PLAN._tick("kill"):
+        _sigkill_self()
+
+
+def collective_round() -> None:
+    """Hook: mid-collective — called once per all-reduce, after the first
+    chunk exchange (``collective/ops.py``); ``kill_collective`` SIGKILLs
+    here, dying with partial chunks on the wire and peers blocked in the
+    same round (the poisoned-round case incarnation fencing + the
+    generation barrier exist for)."""
+    if _PLAN is not None and _PLAN._tick("kill_collective"):
+        _sigkill_self()
 
 
 def drop_heartbeat() -> bool:

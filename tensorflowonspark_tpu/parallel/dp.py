@@ -66,6 +66,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     donate: bool = True,
     accum_steps: int = 1,
+    cross_host_grad_fn: Callable[[Any], Any] | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
     """Build the jitted SPMD train step.
 
@@ -89,43 +90,71 @@ def make_train_step(
     microbatches with few unmasked tokens count more per token.  For masked
     LM training either keep mask density uniform across microbatches or use
     ``accum_steps=1``.
+
+    ``cross_host_grad_fn`` composes the step with CROSS-HOST data
+    parallelism over the cluster wire (``cluster.train(mode="sync")``): a
+    host callable (e.g. ``CollectiveGroup.grad_fn()``) applied to the
+    gradient pytree between backward and update — typically a bucketed
+    ring all-reduce averaging gradients across nodes.  The step then
+    compiles as TWO jitted halves (grads+metrics, then update) sharing the
+    same optimizer code, with the exchange on host in between; each half
+    compiles once, and the hook's bucket pipeline overlaps communication
+    with the device->host tail of backprop.  ``None`` keeps the
+    single-program step byte-for-byte as before.
     """
 
-    def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+    def grads_and_metrics(params: Any, batch: Any) -> tuple[Any, dict]:
         if accum_steps == 1:
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch)
-            metrics = {"loss": loss, **aux}
-        else:
-            micro = jax.tree.map(
-                lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
-                                    *x.shape[1:]), batch)
+                params, batch)
+            return grads, {"loss": loss, **aux}
+        micro = jax.tree.map(
+            lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
+                                *x.shape[1:]), batch)
 
-            def body(carry, mb):
-                grads_acc, metrics_acc = carry
-                (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                    state.params, mb)
-                m = {"loss": l, **aux}
-                return (jax.tree.map(jnp.add, grads_acc, g),
-                        jax.tree.map(jnp.add, metrics_acc, m)), None
+        def body(carry, mb):
+            grads_acc, metrics_acc = carry
+            (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb)
+            m = {"loss": l, **aux}
+            return (jax.tree.map(jnp.add, grads_acc, g),
+                    jax.tree.map(jnp.add, metrics_acc, m)), None
 
-            # Carry structure from an abstract eval — loss_fn is traced once
-            # (inside the scan body), not twice.
-            loss_sd, aux_sd = jax.eval_shape(
-                loss_fn, state.params, jax.tree.map(lambda x: x[0], micro))
-            zeros = lambda sd: jnp.zeros(sd.shape, sd.dtype)  # noqa: E731
-            init = (jax.tree.map(jnp.zeros_like, state.params),
-                    jax.tree.map(zeros, {"loss": loss_sd, **aux_sd}))
-            (grads, msum), _ = jax.lax.scan(body, init, micro)
-            grads = jax.tree.map(lambda g: g / accum_steps, grads)
-            metrics = jax.tree.map(lambda m: m / accum_steps, msum)
+        # Carry structure from an abstract eval — loss_fn is traced once
+        # (inside the scan body), not twice.
+        loss_sd, aux_sd = jax.eval_shape(
+            loss_fn, params, jax.tree.map(lambda x: x[0], micro))
+        zeros = lambda sd: jnp.zeros(sd.shape, sd.dtype)  # noqa: E731
+        init = (jax.tree.map(jnp.zeros_like, params),
+                jax.tree.map(zeros, {"loss": loss_sd, **aux_sd}))
+        (grads, msum), _ = jax.lax.scan(body, init, micro)
+        grads = jax.tree.map(lambda g: g / accum_steps, grads)
+        metrics = jax.tree.map(lambda m: m / accum_steps, msum)
+        return grads, metrics
+
+    def apply_update(state: TrainState, grads: Any) -> TrainState:
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), metrics
+        return TrainState(params, opt_state, state.step + 1)
 
-    # Shardings are inferred from operand placement (replicated params +
-    # dp-sharded batch ⇒ XLA partitions the step and all-reduces grads).
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    if cross_host_grad_fn is None:
+        def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+            grads, metrics = grads_and_metrics(state.params, batch)
+            return apply_update(state, grads), metrics
+
+        # Shardings are inferred from operand placement (replicated params +
+        # dp-sharded batch ⇒ XLA partitions the step and all-reduces grads).
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    grad_step = jax.jit(grads_and_metrics)
+    apply_step = jax.jit(apply_update, donate_argnums=(0,) if donate else ())
+
+    def hooked_step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        grads, metrics = grad_step(state.params, batch)
+        grads = cross_host_grad_fn(grads)
+        return apply_step(state, grads), metrics
+
+    return hooked_step
 
 
 class BNTrainState(NamedTuple):
@@ -192,6 +221,7 @@ def make_batch_iterator(
     pad_to_batch: bool = True,
     prefetch: int = 2,
     max_steps: int | None = -1,
+    lockstep: bool | None = None,
 ):
     """Drain a DataFeed into device-ready, mesh-sharded batches.
 
@@ -225,10 +255,20 @@ def make_batch_iterator(
     voting in the ``all_done`` consensus, and on a multi-process mesh it
     keeps joining the remaining global steps with filler batches — so a
     capped host never deadlocks uncapped peers.
+
+    ``lockstep`` forces the multi-process yield discipline (identical batch
+    counts on every host, filler batches after a host's feed runs dry)
+    WITHOUT a multi-process mesh — the shape cross-host collective training
+    (``cluster.train(mode="sync")`` + ``cross_host_grad_fn``) needs: every
+    global step carries a cluster-wide gradient all-reduce, so a host that
+    stopped yielding early would wedge its peers mid-collective exactly
+    like a missing ``jax.distributed`` participant would.  Default
+    ``None`` keeps the old rule (lockstep iff the mesh spans processes).
     """
     inner = _batch_iterator(feed, batch_size, to_arrays, mesh, ctx,
                             pad_to_batch,
-                            -1 if max_steps is None else int(max_steps))
+                            -1 if max_steps is None else int(max_steps),
+                            lockstep)
     if prefetch <= 0:
         yield from inner
         return
@@ -298,6 +338,7 @@ def _batch_iterator(
     ctx=None,
     pad_to_batch: bool = True,
     max_steps: int = -1,
+    lockstep: bool | None = None,
 ):
     from tensorflowonspark_tpu.parallel.mesh import is_multiprocess, shard_batch
 
@@ -314,17 +355,19 @@ def _batch_iterator(
     # true — if it just skipped rounds, the still-active hosts would enter
     # the next collective without it and the job would hang (SURVEY.md
     # §5.8-3; the reference's MWMS had the same no-early-exit constraint).
-    multiproc = mesh is not None and is_multiprocess(mesh)
+    multiproc = (bool(lockstep) if lockstep is not None
+                 else mesh is not None and is_multiprocess(mesh))
     if multiproc and ctx is None:
         raise ValueError(
-            "multi-process mesh streaming requires ctx: the all_done "
-            "consensus is what keeps per-host global-step counts in lockstep"
+            "lockstep (multi-process mesh / cross-host sync) streaming "
+            "requires ctx: the all_done consensus is what keeps per-host "
+            "global-step counts in lockstep"
         )
     if multiproc and not pad_to_batch:
         raise ValueError(
-            "multi-process mesh streaming requires pad_to_batch=True: every "
+            "lockstep streaming requires pad_to_batch=True: every "
             "host must contribute the same local batch shape or the global "
-            "batch assembly (make_array_from_process_local_data) diverges"
+            "step (batch assembly / gradient collective) diverges"
         )
     last_item = None   # filler source for multi-process end-of-data rounds
     exhausted = False  # feed hit end-of-feed: NEVER call next_batch again

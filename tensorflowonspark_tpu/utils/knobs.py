@@ -45,6 +45,19 @@ _ALL = (
     Knob("TOS_AUTOSCALE_TICK_SECS", "float", "5",
          "Autoscaler cadence: seconds between policy decision cycles "
          "(each tick samples cluster.stats over ~2 ticks of window)."),
+    Knob("TOS_COLLECTIVE_ALGO", "str", "ring",
+         "Cross-host collective all-reduce algorithm: 'ring' (bandwidth-"
+         "optimal chunked ring) or 'naive' (gather-broadcast through rank "
+         "0 — the bench control and tiny-payload fallback)."),
+    Knob("TOS_COLLECTIVE_BUCKET_BYTES", "int", "4194304 (4 MiB)",
+         "Cross-host collectives: gradient-bucket / wire-chunk size — "
+         "pytree leaves pack into buckets of this many bytes (each bucket "
+         "reduced as it fills, overlapping communication with host "
+         "transfer), and ring transfers sub-chunk to it."),
+    Knob("TOS_COLLECTIVE_TIMEOUT", "float", "120",
+         "Budget (seconds) for one cross-host collective exchange and for "
+         "the group-formation rendezvous window; expiry poisons the round "
+         "(CollectiveAborted) instead of wedging the trainer."),
     Knob("TOS_CONNECT_ATTEMPTS", "int", "3",
          "Dial attempts (with backoff + jitter) for control/data-plane "
          "clients before a connection error surfaces."),

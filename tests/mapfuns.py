@@ -546,3 +546,150 @@ def pipelined_consensus_consumer(args, ctx):
             break
     with open(out, "w") as f:
         f.write(status)
+
+
+# -- cross-host collectives (ISSUE 12) ----------------------------------------
+
+
+def collective_ops_probe(args, ctx):
+    """Form a collective group and run every primitive once with exact
+    integer-valued payloads; publish the results for driver-side equality
+    checks (ring and naive must both produce the exact sums)."""
+    import numpy as np
+
+    group = ctx.collective_group(name="probe")
+    group.form()
+    r, w = group.rank, group.world
+    base = np.arange(6, dtype=np.float32).reshape(2, 3) + float(r + 1)
+    ring = group.all_reduce(base, algo="ring")
+    naive = group.all_reduce(base, algo="naive")
+    mean = group.all_reduce(base, average=True, algo="ring")
+    bc = group.broadcast(np.full(5, 8.0, np.float32) if r == 1 else None,
+                         root=1)
+    gathered = group.all_gather(np.full(2 + r, float(r), np.float32))
+    seg_idx, seg = group.reduce_scatter(
+        np.arange(8, dtype=np.float32) * (r + 1))
+    group.barrier()
+    ctx.update_meta({"probe": {
+        "rank": r, "world": w, "generation": group.generation,
+        "ring": ring.tolist(), "naive": naive.tolist(),
+        "mean": mean.tolist(), "bcast": bc.tolist(),
+        "gathered": [g.tolist() for g in gathered],
+        "seg_idx": int(seg_idx), "seg": seg.tolist(),
+    }})
+    group.close()
+
+
+def train_sync_collective(args, ctx):
+    """Feed-driven cross-host synchronous training (``mode="sync"``): each
+    node drains its own streamed partitions in lockstep and the gradient
+    tree mean-reduces across hosts each step via the group's bucketed ring
+    all-reduce — the MultiWorkerMirrored replacement the equivalence test
+    pins against a single-process run on the same data order."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    group = ctx.collective_group()
+    group.form()
+    optimizer = optax.sgd(0.1)
+    state = dplib.TrainState.create(
+        {"w": np.full((3, 1), 0.5, np.float32),
+         "b": np.zeros((1,), np.float32)}, optimizer)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"] + p["b"]
+        err = pred[:, 0] - batch["y"]
+        return jnp.mean(err * err), {}
+
+    train = dplib.make_train_step(loss_fn, optimizer,
+                                  cross_host_grad_fn=group.grad_fn())
+
+    def to_arrays(items):
+        return {"x": np.stack([np.asarray(i[0], np.float32) for i in items]),
+                "y": np.asarray([i[1] for i in items], np.float32)}
+
+    feed = ctx.get_data_feed(train_mode=True)
+    losses = []
+    for batch, _n in dplib.make_batch_iterator(
+            feed, int(args["batch_size"]), to_arrays, ctx=ctx,
+            lockstep=True):
+        state, metrics = train(state, batch)
+        losses.append(float(metrics["loss"]))
+    group.barrier()
+    ctx.update_meta({"sync_train": {
+        "rank": group.rank, "world": group.world, "losses": losses,
+        "final_w": np.asarray(
+            jax.device_get(state.params["w"])).ravel().tolist(),
+        "final_b": float(np.asarray(jax.device_get(state.params["b"]))[0]),
+        "steps": int(jax.device_get(state.step)),
+        "manifest_mode": ctx.job_manifest().get("mode"),
+        "manifest_sync": ctx.job_manifest().get("sync"),
+    }})
+    group.close()
+
+
+def chaos_batch(rank, step, batch_size=8):
+    """Deterministic per-(rank, step) linear-regression batch with small
+    integer-valued floats, so the chaos test's fault-free reference can be
+    recomputed exactly in the driver."""
+    import numpy as np
+
+    base = np.arange(batch_size * 3, dtype=np.float32).reshape(batch_size, 3)
+    x = (base * (1.0 + rank) + step) % 5.0
+    y = (np.arange(batch_size, dtype=np.float32) + rank) % 3.0
+    return {"x": x.astype(np.float32), "y": y.astype(np.float32)}
+
+
+def sync_collective_chaos(args, ctx):
+    """Fixed-step synchronous training on self-generated deterministic
+    data, surviving a SIGKILL mid-all-reduce: survivors abort the poisoned
+    round at the generation barrier, the supervised restart rejoins via
+    ``reform`` + ``sync_state`` (state broadcast from the highest-step
+    survivor), and every node finishes at EXACTLY ``args['steps']`` with
+    identical params equal to the fault-free run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.collective import CollectiveAborted
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    total = int(args["steps"])
+    group = ctx.collective_group(name="chaos")
+    step = group.form(resume_step=0)
+    optimizer = optax.sgd(0.125)
+    state = dplib.TrainState.create(
+        {"w": np.full((3, 1), 0.25, np.float32)}, optimizer)
+    state, step = group.sync_state(state, step)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        err = pred[:, 0] - batch["y"]
+        return jnp.mean(err * err), {}
+
+    train = dplib.make_train_step(loss_fn, optimizer,
+                                  cross_host_grad_fn=group.grad_fn())
+    reforms = 0
+    while step < total:
+        batch = chaos_batch(group.rank, step)
+        try:
+            state, _metrics = train(state, batch)  # victim's kill fires inside
+        except CollectiveAborted:
+            group.reform(resume_step=step)
+            state, step = group.sync_state(state, step)
+            reforms += 1
+            continue
+        step += 1
+    group.barrier()
+    ctx.update_meta({"chaos_sync": {
+        "rank": group.rank, "steps": step, "reforms": reforms,
+        "generation": group.generation, "incarnation": ctx.incarnation,
+        "final_w": np.asarray(
+            jax.device_get(state.params["w"])).ravel().tolist(),
+    }})
+    group.close()

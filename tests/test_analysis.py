@@ -179,6 +179,40 @@ def test_dial_quiet_on_zerocopy_io_in_net_and_dataserver():
     assert lint(src, f"{PKG}/dataserver.py", "dial-discipline") == []
 
 
+def test_dial_fires_on_collective_peer_sockets_outside_transport():
+    """ISSUE 12 satellite: raw peer-to-peer collective sockets are confined
+    to collective/transport.py — even the otherwise-sanctioned
+    connect_with_backoff/bound_socket fire in other collective modules."""
+    found = lint(
+        """
+        import socket
+        from tensorflowonspark_tpu.utils.net import (
+            bound_socket,
+            connect_with_backoff,
+        )
+        def form(addr):
+            srv = bound_socket("")
+            c = connect_with_backoff(addr)
+            s = socket.socket()
+            return srv, c, s
+        """, f"{PKG}/collective/group.py", "dial-discipline")
+    assert {f.anchor for f in found} == {
+        "form@bound_socket", "form@connect_with_backoff", "form@socket"}
+    assert all("collective/transport.py" in f.message for f in found)
+
+
+def test_dial_quiet_in_collective_transport_and_on_zerocopy_io_there():
+    src = """
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+        def dial(addr, sock, bufs, out):
+            c = connect_with_backoff(addr)
+            sock.sendmsg(bufs)
+            sock.recv_into(out)
+            return c
+        """
+    assert lint(src, f"{PKG}/collective/transport.py", "dial-discipline") == []
+
+
 # -- lock discipline ----------------------------------------------------------
 
 _MIXED = """
@@ -200,6 +234,15 @@ def test_lock_fires_on_mixed_locked_unlocked_mutation():
     assert len(found) == 1
     assert found[0].anchor == "C.unlocked_set@mixed:n"
     assert "locked_inc" in found[0].message
+
+
+def test_lock_discipline_covers_collective_modules():
+    """ISSUE 12 satellite: the collective layer joined the threaded set —
+    the same race fixture that fires in cluster.py fires there too."""
+    for basename in ("group.py", "transport.py", "ops.py"):
+        found = lint(_MIXED, f"{PKG}/collective/{basename}", "lock-discipline")
+        assert len(found) == 1, basename
+        assert found[0].anchor == "C.unlocked_set@mixed:n", basename
 
 
 def test_lock_quiet_outside_threaded_modules_and_when_all_locked():

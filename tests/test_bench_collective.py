@@ -1,0 +1,29 @@
+"""Tier-1 smoke for the committed collective bench (ISSUE 12 satellite):
+the bench machinery must keep producing EXACT all-reduce results on a tiny
+payload in both algorithms — a corrupted sum fails inside ``bench_once``
+(every round verifies), it never just skews BENCH_r13's MB/s."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_collective  # noqa: E402
+
+
+def test_bench_quick_exact_and_shape():
+    result = bench_collective.bench(quick=True)
+    assert result["world"] == 2
+    for algo in bench_collective.ALGOS:
+        leg = result[algo]
+        assert leg["agg_mb_per_s"] > 0
+        assert len(leg["round_seconds"]) == result["repeats"]
+        # agg = world x algbw by construction
+        assert leg["agg_mb_per_s"] == round(
+            leg["alg_mb_per_s"] * result["world"], 1) or \
+            abs(leg["agg_mb_per_s"] - leg["alg_mb_per_s"] * 2) < 0.5
+    assert result["ring_vs_naive_x"] > 0
+    out = bench_collective.markdown_table(result)
+    assert "ring" in out and "naive" in out
