@@ -29,11 +29,20 @@ reduced its full payload in ``t`` seconds (t = the slowest node's wall
 time for the round, medianed over rounds).  The acceptance ratio is
 ``ring_vs_naive_x = naive_t / ring_t`` on >= 64 MB payloads.
 
+Round 15 (ISSUE 13) adds the CONTROL-PLANE numbers: ``--scenario r14``
+measures the write-ahead journal's rendezvous-latency cost (interleaved
+journal-on vs journal-off barrier/reduce round-trips on twin coordinators —
+every control-plane mutation now pays an fsync'd append) and the measured
+coordinator RECOVERY TIME: crash -> journal replay -> rebind -> first
+post-failover rendezvous completing, the window a `kill_coordinator` chaos
+run actually rides out.
+
 Usage::
 
     python bench_collective.py                      # full run, markdown + JSON
     python bench_collective.py --quick              # tiny sizes (CI smoke)
     python bench_collective.py --json BENCH_r13.json
+    python bench_collective.py --scenario r14 --json BENCH_r14.json
 """
 
 from __future__ import annotations
@@ -43,6 +52,8 @@ import json
 import multiprocessing as mp
 import os
 import statistics
+import tempfile
+import threading
 import time
 
 import numpy as np
@@ -186,6 +197,168 @@ def bench_r13(repeats: int = 7, payload_mb: float = 64.0,
     }
 
 
+def _timed_rendezvous(server, clients, name: str,
+                      resilient: bool = False) -> float:
+    """Wall seconds for one count=2 reduce to complete for BOTH
+    participants (two threads, joined) — the sync-training control-plane
+    primitive the journal taxes.  ``resilient=True`` follows the failover
+    caller contract (the recovery cell's first post-crash rendezvous rides
+    a reconnect): re-enter on CoordinatorRestarted, like group.form does."""
+    t0 = time.perf_counter()
+
+    def _one(c, v):
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                return c.reduce(name, v, kind="sum", count=2, timeout=30.0)
+            except (RuntimeError, ConnectionError):
+                if not resilient or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.01)
+
+    t = threading.Thread(target=_one, args=(clients[1], 2), daemon=True)
+    t.start()
+    _one(clients[0], 1)
+    t.join()
+    return time.perf_counter() - t0
+
+
+def _journal_pair(journal_path: str | None, slots: int = 2,
+                  stats_interval: float = 1.0):
+    from tensorflowonspark_tpu.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    srv = CoordinatorServer(slots, journal_path=journal_path,
+                            stats_interval=stats_interval)
+    addr = srv.start()
+    clients = []
+    for i in range(slots):
+        c = CoordinatorClient(addr)
+        ident = c.register({"host": f"h{i}"})
+        c.set_identity(ident["executor_id"], ident["incarnation"])
+        clients.append(c)
+    return srv, clients
+
+
+def bench_journal_compare(rounds: int = 300) -> dict:
+    """Interleaved journal-on/off rendezvous-latency compare: twin
+    coordinators (one journaled, one not), each serving the same 2-client
+    count=2 reduce, measured alternately round by round so box drift hits
+    both cells equally.  The delta IS the fsync'd ``rdv_open``+``rdv_close``
+    appends on the journaled path."""
+    with tempfile.TemporaryDirectory() as td:
+        cells = {"journal_off": _journal_pair(None),
+                 "journal_on": _journal_pair(os.path.join(td, "j"))}
+        times: dict[str, list[float]] = {k: [] for k in cells}
+        try:
+            for key, (srv, clients) in cells.items():
+                _timed_rendezvous(srv, clients, "warmup")  # dials + caches
+            for i in range(rounds):
+                order = list(cells) if i % 2 == 0 else list(cells)[::-1]
+                for key in order:
+                    srv, clients = cells[key]
+                    times[key].append(
+                        _timed_rendezvous(srv, clients, f"r{i}"))
+        finally:
+            for srv, clients in cells.values():
+                for c in clients:
+                    c.close()
+                srv.stop()
+    out: dict = {"rounds": rounds}
+    for key, ts in times.items():
+        out[key] = {"p50_us": round(statistics.median(ts) * 1e6, 1),
+                    "p99_us": round(sorted(ts)[int(0.99 * len(ts))] * 1e6, 1)}
+    off, on = out["journal_off"]["p50_us"], out["journal_on"]["p50_us"]
+    out["journal_cost_us_p50"] = round(on - off, 1)
+    out["journal_overhead_pct_p50"] = round(100.0 * (on - off) / off, 1)
+    return out
+
+
+def bench_recovery(slots: int = 8, tail_records: int = 512,
+                   repeats: int = 5) -> dict:
+    """Measured coordinator recovery time: crash -> journal replay (snapshot
+    + ``tail_records`` rendezvous-record tail) -> same-port rebind -> the
+    FIRST post-failover rendezvous completing for both participants.  This
+    is the control-plane blackout a ``kill_coordinator`` chaos run rides
+    out (client reconnect backoff excluded: clients here re-dial eagerly,
+    so the number isolates the server-side cost)."""
+    samples = {"restore_ms": [], "first_rendezvous_ms": []}
+    replayed = 0
+    for _ in range(repeats):
+        with tempfile.TemporaryDirectory() as td:
+            # `slots` registered members; clients[0:2] run the rendezvous,
+            # the rest are idle registered slots the replay must rebuild.
+            # A huge stats interval pins the periodic snapshot off: the
+            # fill below must stay a journal TAIL, or restore_ms would
+            # measure replay of a freshly-truncated (near-empty) journal.
+            srv, clients = _journal_pair(os.path.join(td, "j"), slots=slots,
+                                         stats_interval=3600.0)
+            try:
+                # grow a realistic journal tail: rendezvous open/close pairs
+                for i in range(tail_records // 2):
+                    _timed_rendezvous(srv, clients, f"fill{i}")
+                from tensorflowonspark_tpu.journal import replay as _replay
+
+                srv.crash()
+                tail_len = len(_replay(os.path.join(td, "j"))[1])
+                t0 = time.perf_counter()
+                srv.restore()
+                restore_s = time.perf_counter() - t0
+                rdv_s = _timed_rendezvous(srv, clients, "post",
+                                          resilient=True)
+                samples["restore_ms"].append(round(restore_s * 1e3, 3))
+                samples["first_rendezvous_ms"].append(
+                    round((restore_s + rdv_s) * 1e3, 3))
+                replayed = len(srv.cluster_info())
+            finally:
+                for c in clients:
+                    c.close()
+                srv.stop()
+    return {"slots": slots, "tail_records": tail_records, "repeats": repeats,
+            "replayed_tail_records": tail_len,
+            "replayed_slots": replayed,
+            "restore_ms_median": statistics.median(samples["restore_ms"]),
+            "crash_to_first_rendezvous_ms_median":
+                statistics.median(samples["first_rendezvous_ms"]),
+            "samples": samples}
+
+
+def bench_r14(rounds: int = 300, tail_records: int = 512,
+              repeats: int = 5) -> dict:
+    """The BENCH_r14 scenario (ISSUE 13): what the write-ahead journal
+    costs per rendezvous, and what a coordinator failover costs end to
+    end."""
+    return {
+        "schema": "tos-bench-collective-r14",
+        "journal_compare": bench_journal_compare(rounds),
+        "recovery": bench_recovery(tail_records=tail_records,
+                                   repeats=repeats),
+    }
+
+
+def markdown_r14(result: dict) -> str:
+    jc, rec = result["journal_compare"], result["recovery"]
+    return "\n".join([
+        "| cell | rendezvous p50 us | p99 us |",
+        "|---|---|---|",
+        f"| journal off | {jc['journal_off']['p50_us']} "
+        f"| {jc['journal_off']['p99_us']} |",
+        f"| journal on | {jc['journal_on']['p50_us']} "
+        f"| {jc['journal_on']['p99_us']} |",
+        "",
+        f"journal cost: +{jc['journal_cost_us_p50']} us p50 "
+        f"(+{jc['journal_overhead_pct_p50']}%) over {jc['rounds']} "
+        "interleaved rounds",
+        f"recovery ({rec['replayed_slots']} slots, {rec['tail_records']} "
+        f"tail records): restore {rec['restore_ms_median']} ms, "
+        "crash -> first rendezvous "
+        f"{rec['crash_to_first_rendezvous_ms_median']} ms "
+        f"(median of {rec['repeats']})",
+    ])
+
+
 def markdown_table(result: dict) -> str:
     rows = [
         "| algo | median s | agg MB/s | algbw MB/s |",
@@ -212,10 +385,20 @@ def main(argv=None) -> int:
     ap.add_argument("--payload-mb", type=float, default=None)
     ap.add_argument("--repeats", type=int, default=None)
     ap.add_argument("--bucket-mb", type=float, default=4.0)
-    ap.add_argument("--scenario", choices=("single", "r13"), default="single")
+    ap.add_argument("--scenario", choices=("single", "r13", "r14"),
+                    default="single")
+    ap.add_argument("--rounds", type=int, default=300,
+                    help="r14: interleaved journal-compare rendezvous rounds")
+    ap.add_argument("--tail-records", type=int, default=512,
+                    help="r14: journal tail size replayed by the recovery cell")
     ap.add_argument("--json", default=None, help="write results JSON here")
     args = ap.parse_args(argv)
-    if args.scenario == "r13":
+    if args.scenario == "r14":
+        result = bench_r14(rounds=args.rounds,
+                           tail_records=args.tail_records,
+                           repeats=args.repeats or 5)
+        print(markdown_r14(result))
+    elif args.scenario == "r13":
         result = bench_r13(repeats=args.repeats or 7,
                            payload_mb=args.payload_mb or 64.0,
                            bucket_bytes=int(args.bucket_mb * (1 << 20)))

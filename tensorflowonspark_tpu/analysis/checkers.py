@@ -380,11 +380,63 @@ class ShardIODisciplineChecker(Checker):
         return "b" in mode and not any(c in mode for c in "wax+")
 
 
+# -- journal-write discipline (ISSUE 13) --------------------------------------
+
+# Journal-ish path expressions: the coordinator's write-ahead journal files
+# (coordinator.journal / *.snap) — lexical signal, like the shard heuristic.
+_JOURNALISH_ARG = re.compile(r"journal", re.IGNORECASE)
+_JOURNAL_OPEN_QUALS = frozenset({"open", "io.open", "os.open"})
+
+
+@register_checker
+class JournalDisciplineChecker(Checker):
+    """The write-ahead journal's durability contract lives in ONE module:
+    ``journal.py`` owns every ``os.fsync`` call and every journal-file
+    open.  An ad-hoc fsync elsewhere is a hidden latency cliff on whatever
+    lock its caller holds; an ad-hoc journal-file open bypasses the
+    append-ordering / torn-tail / snapshot-atomicity rules recovery
+    correctness depends on (replay must be able to trust the file)."""
+
+    id = "journal-discipline"
+    hint = ("route durable appends/snapshots through journal.Journal (and "
+            "reads through journal.replay) — fsync discipline and journal "
+            "file opens are confined to journal.py")
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if mod.path.endswith("/journal.py"):
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fq = mod.imports.qualify(node.func)
+            if fq == "os.fsync":
+                yield Finding(
+                    self.id, mod.path, node.lineno,
+                    "os.fsync outside journal.py: durable-write discipline "
+                    "is confined to the journal module",
+                    self.hint, f"{_qual(scope)}@os.fsync")
+                continue
+            if fq not in _JOURNAL_OPEN_QUALS:
+                continue
+            target = node.args[0] if node.args else None
+            target_src = ast.unparse(target) if target is not None else ""
+            if _JOURNALISH_ARG.search(target_src):
+                yield Finding(
+                    self.id, mod.path, node.lineno,
+                    f"journal file opened outside journal.py ({fq}("
+                    f"{target_src[:60]}, ...)) bypasses the append/replay "
+                    "contract",
+                    self.hint, f"{_qual(scope)}@{fq}")
+
+
 # -- 3. lock discipline / race heuristics ------------------------------------
 
 _THREADED_BASENAMES = frozenset({
     "coordinator.py", "cluster.py", "dataserver.py", "supervisor.py",
     "node.py", "feeding.py",
+    # the write-ahead journal: appended from handler threads + the stats
+    # thread's snapshot fold under its own lock
+    "journal.py",
     # the collective layer: dataserver connection threads deliver into the
     # inbox while the comm executor sends and the map_fun thread reforms
     "transport.py", "group.py", "ops.py",

@@ -129,6 +129,14 @@ class Autoscaler:
         """One decision cycle (public so tests and benches can drive the
         loop synchronously).  Returns the decision record for every
         non-hold outcome, else None."""
+        coord = getattr(self._cluster, "coordinator", None)
+        if coord is not None and getattr(coord, "crashed", None) \
+                and coord.crashed():
+            # control plane mid-failover (ISSUE 13): the stats streams were
+            # wiped with the crash — a decision made against that vacuum
+            # would scale on ghosts.  Hold; the journal-recovered epoch's
+            # fresh windows feed the next tick.
+            return None
         stats = self._cluster.stats(self.window)
         current = self._cluster.num_feedable()
         desired = self.policy.desired(stats, current)

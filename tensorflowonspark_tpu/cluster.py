@@ -131,7 +131,16 @@ class _PartitionLedger:
     """
 
     def __init__(self, num_partitions: int, num_epochs: int, num_slots: int,
-                 max_attempts: int = 3):
+                 max_attempts: int = 3, journal_fn: Callable | None = None,
+                 train_gen: int = 0):
+        # Control-plane journal rider (ISSUE 13): assign/ack/requeue events
+        # append to the coordinator's write-ahead journal so a postmortem
+        # (or a future cold-start resume) can reconstruct exact partition
+        # accounting across a control-plane failover.  ``journal_fn`` is a
+        # callable returning the LIVE Journal (or None mid-crash) — the
+        # instance is replaced by every recovery, so it is never cached.
+        self._journal_fn = journal_fn
+        self._train_gen = train_gen
         self._cond = threading.Condition()
         self._own = [
             collections.deque((e, p)
@@ -157,6 +166,28 @@ class _PartitionLedger:
         # home queue went to the orphan pool and survivors deliver it
         self._retired_slots: set[int] = set()
         self.max_attempts = max_attempts
+
+    def _note(self, ev: str, pos: int | None, task: tuple | None = None,
+              **extra) -> None:
+        """Best-effort journal rider for one ledger event (caller may hold
+        ``_cond``; the journal has its own lock).  Failures are logged and
+        swallowed — the in-memory ledger stays authoritative for the run."""
+        if self._journal_fn is None:
+            return
+        journal = self._journal_fn()
+        if journal is None:
+            return  # control plane mid-failover; the ledger itself survives
+        try:
+            # sync=False: ledger riders are flight evidence replay treats as
+            # no-ops — an fsync here would serialize every feed worker on
+            # disk flushes under the ledger condition for nothing recovery
+            # needs (the next mutation append / snapshot flushes them)
+            journal.append("ledger", {"ev": ev, "gen": self._train_gen,
+                                      "slot": pos,
+                                      "task": list(task) if task else None,
+                                      **extra}, sync=False)
+        except Exception:  # noqa: BLE001 - journaling must not break feeding
+            logger.debug("ledger journal append failed", exc_info=True)
 
     def add_slot(self) -> int:
         """Admit one more feed slot mid-run (cluster.resize scale-out);
@@ -207,6 +238,7 @@ class _PartitionLedger:
             self._own[pos].clear()
             self._retired_slots.add(pos)
             self._cond.notify_all()
+            self._note("retire_slot", pos, moved=moved)
             return moved
 
     def slot_idle(self, pos: int) -> bool:
@@ -244,6 +276,8 @@ class _PartitionLedger:
                     continue
                 self._inflight[pos] = task
                 self._attempts[task] = self._attempts.get(task, 0) + 1
+                self._note("assign", pos, task,
+                           attempt=self._attempts[task])
                 return task
 
     def attempts(self, task: tuple[int, int]) -> int:
@@ -260,6 +294,7 @@ class _PartitionLedger:
                 self._delivered[pos].append(task)
                 self._outstanding -= 1
                 self._cond.notify_all()
+                self._note("ack", pos, task, consumed=consumed)
             self._advance_watermark_locked(pos, consumed)
 
     def update_watermark(self, pos: int, consumed: int | None) -> None:
@@ -301,6 +336,7 @@ class _PartitionLedger:
             if task is not None:
                 self._orphans.append(task)
                 self._cond.notify_all()
+                self._note("requeue", pos, task)
             return task
 
     def requeue_unconsumed(self, pos: int) -> int:
@@ -317,6 +353,7 @@ class _PartitionLedger:
             self._outstanding += n
             if n:
                 self._cond.notify_all()
+                self._note("requeue_unconsumed", pos, count=n)
             return n
 
     def abandon_slot(self, pos: int) -> None:
@@ -340,12 +377,14 @@ class _PartitionLedger:
             self._delivered[pos].clear()  # forfeited, not lost
             self._outstanding -= dropped
             self._cond.notify_all()
+            self._note("abandon", pos, dropped=dropped)
 
     def fail(self, exc: Exception) -> None:
         """Unrecoverable: wake every worker with a stop answer."""
         with self._cond:
             if self._failure is None:
                 self._failure = exc
+                self._note("fail", None, reason=str(exc)[:200])
             self._cond.notify_all()
 
 
@@ -417,6 +456,16 @@ class TPUCluster:
         if elastic:
             policy = elastic if isinstance(elastic, RestartPolicy) else None
             self.supervisor = Supervisor(coordinator, launcher, policy)
+        # Control-plane crash recovery (ISSUE 13): a journaled coordinator
+        # gets a supervisor of its own — crash() wakes it, it waits out the
+        # budgeted backoff, and restore() replays the journal under a bumped
+        # epoch.  Independent of `elastic` (node restarts need respawnable
+        # processes; the coordinator restarts in-process from its journal).
+        self.coordinator_supervisor = None
+        if getattr(coordinator, "journal_enabled", False):
+            from tensorflowonspark_tpu.supervisor import CoordinatorSupervisor
+
+            self.coordinator_supervisor = CoordinatorSupervisor(coordinator)
         self._recovery_timeout = _env_float("TOS_RECOVERY_TIMEOUT", 90.0)
         self._max_feed_attempts = _env_int("TOS_MAX_PARTITION_ATTEMPTS", 3)
         # Online serving gateways opened via serve(); closed at shutdown so
@@ -1054,7 +1103,9 @@ class TPUCluster:
             feed_ids = self._feedable_ids()
             ledger = _PartitionLedger(dataset.num_partitions, num_epochs,
                                       len(feed_ids),
-                                      max_attempts=self._max_feed_attempts)
+                                      max_attempts=self._max_feed_attempts,
+                                      journal_fn=self.coordinator.live_journal,
+                                      train_gen=train_gen)
             session["ledger"] = ledger
             self._train_session = session
             self._active_ledger = {eid: (ledger, pos)
@@ -1730,6 +1781,10 @@ class TPUCluster:
         self._monitor_stop.set()
         if self.supervisor is not None:
             self.supervisor.stop()
+        if self.coordinator_supervisor is not None:
+            # a coordinator crash during teardown stays down: the journal is
+            # about saving runs, not resurrecting a server we are stopping
+            self.coordinator_supervisor.stop()
         # Serving gateways first: their routers hold data-plane connections
         # and must stop dispatching before EOF ends the serving_loops.
         for gw in self._gateways:
@@ -1965,6 +2020,12 @@ class TPUCluster:
                  if self.supervisor.restart_count(eid)}
                 if self.supervisor is not None else {}),
         }
+        if self.coordinator_supervisor is not None and self.coordinator.epoch:
+            # a control-plane failover happened: the headline evidence
+            extras["coordinator"] = {
+                "epoch": self.coordinator.epoch,
+                "recoveries": self.coordinator_supervisor.restart_count(),
+            }
         if self._resize_log or self._autoscalers:
             # the elasticity postmortem: every resize the run performed and
             # (when a policy loop drove them) every decision it took
@@ -2140,10 +2201,18 @@ def run(
         raise ValueError(f"per_node_env needs {num_executors} entries, got {len(per_node_env)}")
     roles = _build_roles(num_executors, master_node, eval_node)
     authkey = secrets.token_bytes(16)
-    coordinator = CoordinatorServer(num_executors, roles, authkey=authkey)
-    addr = coordinator.start(coordinator_host)
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    # Control-plane write-ahead journal (ISSUE 13): with a log_dir every
+    # coordinator mutation is journaled to <log_dir>/coordinator.journal and
+    # a coordinator crash becomes a supervised, epoch-bumping restart
+    # (TPUCluster wires the CoordinatorSupervisor); journal-less
+    # coordinators keep the old behaviour — a crash is fatal.
+    coordinator = CoordinatorServer(
+        num_executors, roles, authkey=authkey,
+        journal_path=(os.path.join(log_dir, "coordinator.journal")
+                      if log_dir else None))
+    addr = coordinator.start(coordinator_host)
 
     configs = [
         NodeConfig(

@@ -150,7 +150,11 @@ class CollectiveGroup:
         Retries through coordinator-side aborts: a rendezvous generation
         poisoned by a death declaration (or by one member timing out while
         the restarted slot is still booting) is simply re-entered until the
-        full world stands at the barrier or ``timeout`` expires.
+        full world stands at the barrier or ``timeout`` expires.  A
+        coordinator CRASH mid-formation rides the same loop: the client
+        reconnects with backoff against the journal-recovered server (or a
+        ``CoordinatorRestarted``/epoch-fence reply) and re-enters — the
+        generation barrier is also the control-plane failover barrier.
         """
         if self._closed:
             raise CollectiveAborted(f"collective group {self.name!r} is closed")
@@ -172,10 +176,13 @@ class CollectiveGroup:
                     f"cg.{self.name}.form", me, count=self.world,
                     timeout=min(10.0, max(1.0, remaining)))
                 break
-            except RuntimeError as e:
-                # peer-abort / slice timeout / death-declaration abort:
-                # re-enter the barrier (the restarted slot may still be
-                # riding out its supervisor backoff)
+            except (RuntimeError, ConnectionError) as e:
+                # peer-abort / slice timeout / death-declaration abort /
+                # coordinator failover (CoordinatorRestarted, or the
+                # reconnect itself still failing while the control plane
+                # restores): re-enter the barrier — the restarted slot may
+                # still be riding out its supervisor backoff, and a
+                # recovering coordinator its own
                 last_err = e
                 time.sleep(0.2)
         members = result["members"]

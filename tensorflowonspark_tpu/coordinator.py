@@ -24,6 +24,7 @@ collectives over ICI emitted by jit-compiled SPMD programs (SURVEY.md §5.8-2).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import socket
@@ -33,7 +34,7 @@ import threading
 import time
 from typing import Any
 
-from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.telemetry.registry import percentile_of
 
@@ -51,12 +52,29 @@ _TRACE_EVENT_CAP = 1024
 # tick (driver); 240 entries at ~1-2s cadence cover several minutes of
 # window, far past any sensible `cluster.stats(window=...)`.
 _STATS_HISTORY_CAP = 240
+# Write-ahead journal snapshot cadence: after this many appended records the
+# stats thread folds the full control-plane state into <journal>.snap and
+# truncates the tail, so crash recovery replays O(delta) records.
+_JOURNAL_SNAPSHOT_EVERY = 256
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
 
 
+class CoordinatorRestarted(RuntimeError):
+    """The control plane crashed and restarted under this call: the
+    connection (or rendezvous generation) the request rode is gone, or the
+    request carried a pre-crash coordinator epoch and was fenced.  The
+    client has already reconnected and learned the new epoch — callers own
+    the retry at their own abstraction level (a collective group re-forms
+    at the next generation barrier; idempotent ops are retried
+    transparently and never raise this)."""
+
+
 def _send_msg(sock: socket.socket, obj: dict) -> None:
+    # chaos seam: `delay_net:ms=M` injects latency on every control-plane
+    # send in the armed process (no-op unless TOS_FAULTINJECT armed it)
+    faultinject.net_delay()
     data = json.dumps(obj).encode("utf-8")
     sock.sendall(_LEN.pack(len(data)) + data)
 
@@ -181,7 +199,8 @@ class CoordinatorServer:
     """
 
     def __init__(self, expected: int, roles: list[tuple[str, int]] | None = None,
-                 authkey: bytes | None = None, stats_interval: float = 1.0):
+                 authkey: bytes | None = None, stats_interval: float = 1.0,
+                 journal_path: str | None = None):
         if roles is not None and len(roles) != expected:
             raise ValueError("roles must have one entry per expected node")
         self.expected = expected
@@ -241,6 +260,36 @@ class CoordinatorServer:
         # published so map_funs can read progress denominators without a
         # side channel (ctx.job_manifest()).
         self._manifest: dict = {}
+        # Serving replica registry: each ReplicaRouter publishes its healthy
+        # replica set here (journal-backed), so a control-plane failover
+        # restores which replicas were serving — statz/run-report evidence
+        # operators read after the fact.
+        self._serving: dict[str, list[int]] = {}
+        # Write-ahead journal (ISSUE 13): every control-plane mutation
+        # appends an fsync'd record (under self._lock, so record order IS
+        # mutation order); crash() + restore() replay it into this same
+        # object under a bumped COORDINATOR EPOCH carried on every reply.
+        # truncate=True: a fresh server is a fresh run — a stale journal
+        # from a previous cluster in the same log_dir must never replay.
+        self._journal_path = journal_path
+        self._journal = None
+        if journal_path:
+            from tensorflowonspark_tpu.journal import Journal
+
+            self._journal = Journal(journal_path, truncate=True)
+        self._epoch = 0
+        self._crashed = threading.Event()
+        self._crash_listeners: list = []
+        # live handler connections, severed wholesale by crash() so every
+        # client observes an abrupt coordinator death (ECONNRESET), exactly
+        # like a real process kill would present
+        self._conns: set[socket.socket] = set()
+        # initial role template, the restore() fallback when no snapshot
+        # exists yet (the journal tail then replays every mutation since)
+        self._init_roles = list(self.roles)
+        self._init_expected = expected
+        self._bind_host: str | None = None
+        self._port = 0
         self._server: socketserver.ThreadingTCPServer | None = None
         self._thread: threading.Thread | None = None
         self.address: tuple[str, int] | None = None
@@ -262,6 +311,35 @@ class CoordinatorServer:
         ``TOS_COORDINATOR_HOST``) to pin a specific interface; that exact
         address is then advertised.
         """
+        # Chaos hooks (kill_coordinator / delay_net) arm from the driver's
+        # own environment; idempotent when a test armed them explicitly.
+        faultinject.init_from_env()
+        if host is None:
+            # Only an authenticated server may take a network bind from the
+            # environment — TOS_COORDINATOR_HOST must never silently expose
+            # an unauthenticated register/stop channel.
+            from tensorflowonspark_tpu.utils.envtune import env_str
+
+            host = (env_str("TOS_COORDINATOR_HOST", "")
+                    if self.authkey is not None else "127.0.0.1")
+        bind_host = "" if host in ("", "0.0.0.0") else host
+        self._bind_host = bind_host
+        self._start_server(bind_host, 0)
+        if bind_host == "":
+            from tensorflowonspark_tpu.utils.net import local_ip
+
+            advertise = local_ip()
+        else:
+            advertise = bind_host
+        self.address = (advertise, self._port)
+        self._start_stats_thread()
+        logger.info("coordinator listening on %s:%d (expecting %d nodes)", *self.address, self.expected)
+        return self.address
+
+    def _start_server(self, bind_host: str, port: int) -> None:
+        """Bind + start the request server on ``(bind_host, port)`` (port 0
+        = pick one; restore() passes the ORIGINAL port so recovering clients
+        redial the address baked into every NodeConfig)."""
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -285,6 +363,8 @@ class CoordinatorServer:
                         self.request.settimeout(None)
                     except (ConnectionError, OSError):
                         return
+                with outer._lock:
+                    outer._conns.add(self.request)
                 try:
                     while True:
                         msg = _recv_msg(self.request)
@@ -294,31 +374,21 @@ class CoordinatorServer:
                             return
                 except (ConnectionError, OSError):
                     return
+                finally:
+                    with outer._lock:
+                        outer._conns.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
 
-        if host is None:
-            # Only an authenticated server may take a network bind from the
-            # environment — TOS_COORDINATOR_HOST must never silently expose
-            # an unauthenticated register/stop channel.
-            from tensorflowonspark_tpu.utils.envtune import env_str
-
-            host = (env_str("TOS_COORDINATOR_HOST", "")
-                    if self.authkey is not None else "127.0.0.1")
-        bind_host = "" if host in ("", "0.0.0.0") else host
-        self._server = Server((bind_host, 0), Handler)
-        port = self._server.server_address[1]
-        if bind_host == "":
-            from tensorflowonspark_tpu.utils.net import local_ip
-
-            advertise = local_ip()
-        else:
-            advertise = bind_host
-        self.address = (advertise, port)
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True, name="coordinator")
+        self._server = Server((bind_host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="coordinator")
         self._thread.start()
+
+    def _start_stats_thread(self) -> None:
         # driver stats sampler: the rolling-window half of cluster.stats()
         # for THIS process's registry (nodes sample themselves implicitly,
         # one history entry per heartbeat merge)
@@ -326,8 +396,6 @@ class CoordinatorServer:
                                               daemon=True,
                                               name="coordinator-stats")
         self._stats_thread.start()
-        logger.info("coordinator listening on %s:%d (expecting %d nodes)", *self.address, self.expected)
-        return self.address
 
     def stop(self) -> None:
         self._stop_flag.set()
@@ -339,6 +407,295 @@ class CoordinatorServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self._journal is not None:
+            with contextlib.suppress(Exception):
+                self._journal.close()
+
+    # -- crash / journaled recovery (ISSUE 13) -------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """Coordinator epoch: bumped by every journaled recovery; carried on
+        every control-plane reply so clients detect a failover (0 = the
+        control plane has never crashed)."""
+        return self._epoch
+
+    @property
+    def journal_enabled(self) -> bool:
+        return self._journal_path is not None
+
+    def live_journal(self):
+        """The current Journal instance, or None while crashed / journal-
+        less — the indirection ledger riders use so they never append to a
+        pre-crash journal generation's closed fd."""
+        if self._crashed.is_set():
+            return None
+        return self._journal
+
+    def add_crash_listener(self, callback) -> None:
+        """Register a zero-arg callable invoked (once, from the crashing
+        thread) when the control plane crashes — the CoordinatorSupervisor's
+        wake-up."""
+        self._crash_listeners.append(callback)
+
+    def crashed(self) -> bool:
+        return self._crashed.is_set()
+
+    def _log(self, rec_kind: str, sync: bool = True, **payload) -> None:
+        """Append one journal record.  Caller MUST hold ``self._lock`` when
+        journaling a state mutation (record order is replay order).
+        ``sync=False`` is for the purely observational rendezvous-lifecycle
+        records replay treats as no-ops: they skip the fsync (the next
+        synced mutation or snapshot flushes them), so the per-generation
+        hot path never pays a disk flush for flight evidence."""
+        j = self._journal
+        if j is None or self._crashed.is_set():
+            return
+        try:
+            j.append(rec_kind, payload, sync=sync)
+        except Exception:  # noqa: BLE001 - a full disk must not kill the control plane
+            logger.warning("journal append (%s) failed", rec_kind,
+                           exc_info=True)
+
+    def _snapshot_state_locked(self) -> dict:
+        """Full control-plane state, JSON-safe, for a journal snapshot."""
+        return {
+            "epoch": self._epoch,
+            "expected": self.expected,
+            "roles": [[name, task] for name, task in self.roles],
+            "nodes": [dict(m) for m in self._nodes],
+            "incarnations": {str(k): v for k, v in self._incarnations.items()},
+            "draining": sorted(self._draining),
+            "retired": sorted(self._retired),
+            "manifest": dict(self._manifest),
+            "errors": [dict(e) for e in self._errors],
+            "serving": {k: list(v) for k, v in self._serving.items()},
+            "complete": self._complete.is_set(),
+            # registered slots with no liveness clock (declared dead, or
+            # cleanly deregistered): restore must NOT re-seed them, or a
+            # finished node would later be re-declared dead and fail the job
+            "untracked": sorted(int(m["executor_id"]) for m in self._nodes
+                                if m["executor_id"] not in self._last_seen),
+        }
+
+    def _maybe_snapshot(self) -> None:
+        """Periodic snapshot (stats-thread cadence): fold the journal tail
+        into ``<journal>.snap`` once it grows past the threshold, holding
+        ``_lock`` across build-and-write so the snapshot is consistent with
+        every mutation record it truncates."""
+        j = self._journal
+        if j is None or self._crashed.is_set():
+            return
+        if j.appended_since_snapshot() < _JOURNAL_SNAPSHOT_EVERY:
+            return
+        try:
+            with self._lock:
+                j.snapshot(self._snapshot_state_locked())
+        except Exception:  # noqa: BLE001 - snapshotting is an optimization, never fatal
+            logger.warning("journal snapshot failed", exc_info=True)
+
+    def crash(self) -> None:
+        """Kill the control-plane server component abruptly (chaos /
+        ``kill_coordinator``): sever every live connection, stop the server
+        and sampler threads, abort in-flight rendezvous, and WIPE the
+        in-memory control-plane state — everything a real coordinator
+        process death would take with it.  The fsync'd journal on disk is
+        the only survivor; :meth:`restore` rebuilds from it.  Telemetry /
+        trace stores are process-local observability, kept so the run's
+        postmortem spans the failover."""
+        if self._crashed.is_set():
+            return
+        self._crashed.set()
+        logger.error("coordinator control plane CRASHED (epoch %d); journal "
+                     "at %s", self._epoch, self._journal_path)
+        telemetry.counter("coordinator.crashes_total").inc()
+        ttrace.event("coordinator_crash", epoch=self._epoch)
+        if self._journal is not None:
+            with contextlib.suppress(Exception):
+                self._journal.close()
+        # sever: listening socket + every accepted connection, abruptly
+        server, self._server = self._server, None
+        if server is not None:
+            with contextlib.suppress(Exception):
+                server.shutdown()
+                server.server_close()
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            with contextlib.suppress(OSError):
+                conn.close()
+        # waiters blocked inside _op_reduce would otherwise ride out their
+        # full timeout against a server that no longer exists
+        self._abort_rendezvous()
+        self._stats_stop.set()
+        if self._stats_thread is not None:
+            self._stats_thread.join(timeout=5.0)
+            self._stats_thread = None
+        with self._lock:
+            self._nodes = []
+            self._last_seen = {}
+            self._incarnations = {}
+            self._draining = set()
+            self._retired = set()
+            self._errors = []
+            self._manifest = {}
+            self._serving = {}
+            self._rdv = {}
+        for cb in list(self._crash_listeners):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - a listener bug must not mask the crash
+                logger.warning("coordinator crash listener failed",
+                               exc_info=True)
+
+    def restore(self) -> int:
+        """Recover from :meth:`crash`: replay the journal (snapshot + tail)
+        into this object, bump the coordinator epoch, rebind the ORIGINAL
+        port, and seed every registered live slot's liveness clock so
+        reconnecting nodes get the full death-declaration window to
+        re-assert themselves.  Returns the new epoch."""
+        if not self._crashed.is_set():
+            raise RuntimeError("restore() is only valid after crash()")
+        if self._journal_path is None:
+            raise RuntimeError("cannot restore a journal-less coordinator")
+        from tensorflowonspark_tpu import journal as journal_mod
+
+        snap, records = journal_mod.replay(self._journal_path)
+        snap = snap or {}
+        with self._lock:
+            self.roles = [tuple(r) for r in snap.get("roles",
+                                                     self._init_roles)]
+            self.expected = int(snap.get("expected", self._init_expected))
+            self._epoch = int(snap.get("epoch", self._epoch))
+            self._nodes = [dict(m) for m in snap.get("nodes") or []]
+            self._incarnations = {int(k): int(v) for k, v in
+                                  (snap.get("incarnations") or {}).items()}
+            self._draining = set(snap.get("draining") or [])
+            self._retired = set(snap.get("retired") or [])
+            self._manifest = dict(snap.get("manifest") or {})
+            self._errors = [dict(e) for e in snap.get("errors") or []]
+            self._serving = {k: [int(x) for x in v] for k, v in
+                             (snap.get("serving") or {}).items()}
+            complete = bool(snap.get("complete", False))
+            untracked = {int(x) for x in snap.get("untracked") or []}
+            for rec in records:
+                complete = self._apply_record_locked(rec, complete, untracked)
+            self._epoch += 1
+            epoch = self._epoch
+            if complete or (self._nodes and len(self._nodes) >= self.expected):
+                self._complete.set()
+            # re-admit grace: every slot that was liveness-tracked at the
+            # crash is treated as alive NOW — its node has the full
+            # dead-node window to reconnect and re-assert itself.  Slots
+            # already dead / deregistered / retired pre-crash stay
+            # untracked: re-seeding a finished node would get it
+            # re-declared dead later and fail a healthy run.
+            now = time.monotonic()
+            for m in self._nodes:
+                eid = int(m["executor_id"])
+                if eid not in self._retired and eid not in untracked:
+                    self._last_seen[eid] = now
+            live = len(self._last_seen)
+        # fresh journal generation anchored by a snapshot of the restored
+        # state (carries the bumped epoch; keeps the replay tail O(delta))
+        self._journal = journal_mod.Journal(self._journal_path)
+        with self._lock:
+            self._journal.snapshot(self._snapshot_state_locked())
+        self._start_server(self._bind_host or "", self._port)
+        self._stats_stop.clear()
+        self._start_stats_thread()
+        self._crashed.clear()
+        telemetry.counter("coordinator.recoveries_total").inc()
+        telemetry.gauge("coordinator.epoch").set(epoch)
+        telemetry.gauge("coordinator.live_slots").set(live)
+        ttrace.event("coordinator_replay", epoch=epoch,
+                     records=len(records), nodes=len(self._nodes))
+        ttrace.event("coordinator_up", epoch=epoch)
+        logger.warning("coordinator RECOVERED at epoch %d (%d slot(s) "
+                       "replayed, %d tail record(s)); clients re-admit over "
+                       "the next heartbeats", epoch, len(self._nodes),
+                       len(records))
+        return epoch
+
+    def _apply_record_locked(self, rec: dict, complete: bool,
+                             untracked: set[int]) -> bool:
+        """Replay one journal tail record into live state (``untracked``
+        accumulates slots that must NOT get a liveness clock re-seeded);
+        returns the updated formation-complete flag.  Purely-observational
+        kinds (rendezvous lifecycle, ledger riders) replay as no-ops."""
+        kind, d = rec.get("k"), rec.get("d") or {}
+        if kind == "register":
+            meta = dict(d["meta"])
+            eid = int(meta["executor_id"])
+            untracked.discard(eid)
+            slot = next((m for m in self._nodes
+                         if m["executor_id"] == eid), None)
+            if d.get("replace") and slot is not None:
+                slot.clear()
+                slot.update(meta)
+            elif slot is None:
+                self._nodes.append(meta)
+            if len(self._nodes) >= self.expected:
+                complete = True
+        elif kind == "dead":
+            for eid in d.get("eids") or []:
+                untracked.add(int(eid))
+                self._incarnations[int(eid)] = \
+                    self._incarnations.get(int(eid), 0) + 1
+        elif kind == "deregister":
+            untracked.add(int(d["eid"]))
+        elif kind == "open_slots":
+            self.roles.extend((name, int(task))
+                              for name, task in d.get("roles") or [])
+            self.expected += len(d.get("roles") or [])
+        elif kind == "cancel_slots":
+            for eid in d.get("cancelled") or []:
+                if int(eid) == len(self.roles) - 1:
+                    self.roles.pop()
+                    self.expected -= 1
+            for eid in d.get("retired") or []:
+                self._retire_replay_locked(int(eid))
+        elif kind == "draining":
+            self._draining.update(int(e) for e in d.get("eids") or [])
+        elif kind == "retired":
+            self._retire_replay_locked(int(d["eid"]))
+        elif kind == "manifest":
+            self._manifest = dict(d.get("manifest") or {})
+        elif kind == "error":
+            self._errors.append({"executor_id": d.get("executor_id"),
+                                 "traceback": d.get("traceback", "")})
+        elif kind == "serving":
+            self._serving[str(d.get("gateway"))] = \
+                [int(x) for x in d.get("replicas") or []]
+        # rdv_open / rdv_close / rdv_abort / form / ledger: flight-record
+        # riders — the generations they describe died with the crash and
+        # re-form client-side at the next generation barrier.  The epoch
+        # itself persists exclusively through snapshots (restore() writes
+        # one immediately after every bump), never through tail records.
+        return complete
+
+    def _retire_replay_locked(self, executor_id: int) -> None:
+        self._incarnations[executor_id] = \
+            self._incarnations.get(executor_id, 0) + 1
+        self._draining.discard(executor_id)
+        self._retired.add(executor_id)
+        for m in self._nodes:
+            if m["executor_id"] == executor_id:
+                m["retired"] = True
+
+    # -- serving replica registry (journal-backed) ----------------------------
+
+    def note_serving_replicas(self, gateway: str, replicas: list[int]) -> None:
+        """Record one router's healthy replica set (journaled, restored
+        across a control-plane failover)."""
+        with self._lock:
+            self._serving[str(gateway)] = sorted(int(r) for r in replicas)
+            self._log("serving", gateway=str(gateway),
+                      replicas=self._serving[str(gateway)])
+
+    def serving_replicas(self) -> dict[str, list[int]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._serving.items()}
 
     # -- driver-side queries -------------------------------------------------
 
@@ -368,7 +725,12 @@ class CoordinatorServer:
             return list(self._errors)
 
     def dead_nodes(self, heartbeat_timeout: float) -> list[int]:
-        """Nodes whose heartbeat went silent (deregistered nodes excluded)."""
+        """Nodes whose heartbeat went silent (deregistered nodes excluded).
+        Empty while the control plane is mid-failover: liveness was wiped
+        with the crash, and declaring anyone dead before recovery re-seeds
+        the clocks would fence every healthy reconnecting node."""
+        if self._crashed.is_set():
+            return []
         now = time.monotonic()
         with self._lock:
             return [i for i, t in self._last_seen.items() if now - t > heartbeat_timeout]
@@ -411,6 +773,9 @@ class CoordinatorServer:
                                       "or host unreachable); detected by driver "
                                       "monitor (SURVEY.md §5.3)"),
                     })
+                    self._log("error", **self._errors[-1])
+            if newly:
+                self._log("dead", eids=newly)
             live = len(self._last_seen)
         if newly:
             telemetry.counter("coordinator.deaths_total").inc(len(newly))
@@ -426,12 +791,20 @@ class CoordinatorServer:
         control plane is JSON-framed)."""
         with self._lock:
             self._manifest = dict(manifest)
+            self._log("manifest", manifest=self._manifest)
+
+    def manifest_state(self) -> dict:
+        """Driver-side view of the published job manifest (the ``manifest``
+        op's payload)."""
+        with self._lock:
+            return dict(self._manifest)
 
     def record_failure(self, executor_id: int, reason: str) -> None:
         """Driver-side synthesized node error (e.g. supervised restart budget
         exhausted) — surfaces through the same channel map_fun errors use."""
         with self._lock:
             self._errors.append({"executor_id": executor_id, "traceback": reason})
+            self._log("error", executor_id=executor_id, traceback=reason)
 
     def is_tracked(self, executor_id: int) -> bool:
         """Whether the executor is currently liveness-tracked (alive)."""
@@ -462,8 +835,11 @@ class CoordinatorServer:
             next_task = 1 + max(
                 (t for name, t in self.roles if name == job_name), default=-1)
             new_ids = list(range(len(self.roles), len(self.roles) + count))
-            self.roles.extend((job_name, next_task + i) for i in range(count))
+            new_roles = [(job_name, next_task + i) for i in range(count)]
+            self.roles.extend(new_roles)
             self.expected += count
+            self._log("open_slots", ids=new_ids,
+                      roles=[[n, t] for n, t in new_roles])
         logger.info("opened %d new executor slot(s): ids %s", count, new_ids)
         return new_ids
 
@@ -499,6 +875,7 @@ class CoordinatorServer:
         retired: list[int] = []
         with self._lock:
             taken = {m["executor_id"] for m in self._nodes}
+            cancelled: list[int] = []
             # ids are assigned in registration order, so the unregistered
             # promised slots are always the tail of the role table
             for eid in sorted(executor_ids, reverse=True):
@@ -509,6 +886,9 @@ class CoordinatorServer:
                 if eid == len(self.roles) - 1:
                     self.roles.pop()
                     self.expected -= 1
+                    cancelled.append(eid)
+            if cancelled or retired:
+                self._log("cancel_slots", cancelled=cancelled, retired=retired)
         if retired:
             telemetry.gauge("coordinator.live_slots").set(live)
         for eid in retired:
@@ -522,6 +902,7 @@ class CoordinatorServer:
         mid-drain finalizes the retirement instead of scheduling recovery."""
         with self._lock:
             self._draining.update(executor_ids)
+            self._log("draining", eids=list(executor_ids))
 
     def draining_nodes(self) -> list[int]:
         with self._lock:
@@ -553,6 +934,7 @@ class CoordinatorServer:
         stream so dashboards stop averaging a ghost."""
         with self._lock:
             live = self._retire_locked(executor_id)
+            self._log("retired", eid=executor_id)
         telemetry.gauge("coordinator.live_slots").set(live)
         ttrace.event("retired", executor=executor_id)
         logger.info("executor %d retired (intentional scale-in)", executor_id)
@@ -658,6 +1040,10 @@ class CoordinatorServer:
                 self._sample_driver_stats()
             except Exception:  # noqa: BLE001 - observability must not kill jobs
                 logger.debug("driver stats sample failed", exc_info=True)
+            # journal housekeeping rides the same tick: fold the tail into a
+            # snapshot once it grows past the threshold (keeps recovery
+            # replay O(delta) without adding a thread)
+            self._maybe_snapshot()
 
     def _sample_driver_stats(self) -> None:
         """One driver history entry: cumulative counters + gauges + the
@@ -708,6 +1094,11 @@ class CoordinatorServer:
             "replicas_draining": (driver.get("gauges") or {}).get(
                 "serve.replicas_draining"),
             "draining_nodes": self.draining_nodes(),
+            # the journal-backed registry: which replicas each router had
+            # healthy as of its last publish (survives a coordinator
+            # failover — the epoch shows whether one happened)
+            "replica_registry": self.serving_replicas(),
+            "coordinator_epoch": self._epoch,
             "feed_queue_depth": {
                 key: (s.get("gauges") or {}).get("feed.queue_depth")
                 for key, s in out["streams"].items() if key != "driver"},
@@ -769,8 +1160,40 @@ class CoordinatorServer:
             return int(inc) < self._incarnations.get(int(eid), 0)
 
     def _dispatch(self, msg: dict) -> dict:
+        # chaos seam (`kill_coordinator:after_ops=N`): the Nth control-plane
+        # request crashes the server BEFORE being served — its reply dies
+        # with the connection, exactly like a request in flight at a real
+        # coordinator death
+        if faultinject.coordinator_op():
+            self.crash()
+            return {"ok": False, "error": "coordinator crashed (fault injection)"}
+        if self._crashed.is_set():
+            # a request raced the crash on a not-yet-severed socket: refuse
+            # it rather than serving wiped state; the client's reconnect
+            # backoff owns riding out the restart window
+            return {"ok": False, "error": "coordinator is mid-failover; retry"}
+        resp = self._dispatch_inner(msg)
+        # coordinator epoch rides EVERY reply: clients detect a failover by
+        # the bump and re-assert (idempotent ops retry; rendezvous re-form)
+        resp.setdefault("epoch", self._epoch)
+        return resp
+
+    def _dispatch_inner(self, msg: dict) -> dict:
         op = msg.get("op")
         try:
+            ep = msg.get("coordinator_epoch")
+            if ep is not None and int(ep) < self._epoch \
+                    and op in ("barrier", "reduce"):
+                # Epoch fencing, the failover twin of incarnation fencing: a
+                # barrier/reduce composed against a pre-crash epoch belongs
+                # to a generation that died with the crash — joining a live
+                # one could satisfy (and corrupt) a rendezvous its sender
+                # never meant.  Idempotent ops pass: the reply's epoch
+                # re-syncs the client.
+                return {"ok": False, "stale_epoch": True,
+                        "error": (f"request from coordinator epoch {ep} fenced "
+                                  f"(current epoch {self._epoch}): the control "
+                                  "plane restarted; re-sync and retry")}
             if op != "register" and self._is_fenced(msg):
                 # TF-Replicator-style generation fencing: the zombie must
                 # never influence live state.  Heartbeats answer stop=True so
@@ -845,7 +1268,9 @@ class CoordinatorServer:
                 # metrics snapshot rides along — work done after the last
                 # heartbeat must still reach the cluster view.
                 with self._lock:
-                    self._last_seen.pop(msg["executor_id"], None)
+                    if self._last_seen.pop(msg["executor_id"], None) is not None:
+                        self._log("deregister",
+                                  eid=int(msg["executor_id"]))
                     if msg.get("metrics"):
                         self._merge_metrics_locked(int(msg["executor_id"]),
                                                    msg["metrics"])
@@ -881,6 +1306,7 @@ class CoordinatorServer:
             job_name, task_index = self.roles[executor_id]
             meta.update(executor_id=executor_id, job_name=job_name, task_index=task_index)
             self._nodes.append(meta)
+            self._log("register", meta=dict(meta), replace=False)
             self._last_seen[executor_id] = time.monotonic()
             incarnation = self._incarnations.get(executor_id, 0)
             if len(self._nodes) == self.expected:
@@ -920,6 +1346,7 @@ class CoordinatorServer:
             meta.update(executor_id=executor_id, job_name=job_name, task_index=task_index)
             slot.clear()
             slot.update(meta)
+            self._log("register", meta=dict(meta), replace=True)
             self._last_seen[executor_id] = time.monotonic()
             incarnation = self._incarnations.get(executor_id, 0)
             live = len(self._last_seen)
@@ -948,6 +1375,8 @@ class CoordinatorServer:
             # but guard anyway: never join a finished generation.
             if rdv is None or rdv.done or rdv.aborted:
                 rdv = self._rdv[name] = _Rendezvous(count)
+                self._log("rdv_open", sync=False, name=name, count=count,
+                          kind=kind)
             elif rdv.count != count:
                 return {"ok": False, "error": f"reduce {name!r}: conflicting participant counts "
                                               f"({rdv.count} vs {count})"}
@@ -968,6 +1397,16 @@ class CoordinatorServer:
                 with self._lock:
                     if self._rdv.get(name) is rdv:
                         del self._rdv[name]
+                    self._log("rdv_close", sync=False, name=name, kind=kind)
+                    if kind == "form":
+                        # collective membership is control-plane state worth
+                        # keeping: the postmortem (and a future cold-start
+                        # resume) can see who stood at which generation
+                        self._log("form", name=name,
+                                  members=[int(m["eid"])
+                                           for m in rdv.result["members"]],
+                                  generation=rdv.result["generation"],
+                                  step=rdv.result["step"])
                 rdv.cond.notify_all()
             else:
                 deadline = time.monotonic() + timeout
@@ -978,6 +1417,7 @@ class CoordinatorServer:
                         with self._lock:
                             if self._rdv.get(name) is rdv:
                                 del self._rdv[name]
+                            self._log("rdv_abort", sync=False, name=name)
                         rdv.cond.notify_all()
                         return {"ok": False, "error": f"barrier/reduce {name!r} timed out"}
                     rdv.cond.wait(min(remaining, 0.5))
@@ -987,46 +1427,85 @@ class CoordinatorServer:
 
 
 class CoordinatorClient:
-    """Node-side client (reference ``reservation.Client``), persistent socket."""
+    """Node-side client (reference ``reservation.Client``), persistent socket.
+
+    Failover behaviour (ISSUE 13): every reply carries the coordinator
+    EPOCH; a bump means the control plane crashed and recovered from its
+    journal.  On a broken connection the client redials with backoff
+    (``TOS_CONNECT_ATTEMPTS``) and transparently retries IDEMPOTENT ops
+    (heartbeat, queries, update_meta, deregister, error); a barrier/reduce
+    instead raises :class:`CoordinatorRestarted` after reconnecting — its
+    rendezvous generation died with the crash, and whether to re-enter (a
+    fresh generation) is the caller's SPMD-consistency decision, never the
+    transport's.
+    """
 
     def __init__(self, address: tuple[str, int], connect_timeout: float = 30.0,
-                 authkey: bytes | None = None):
+                 authkey: bytes | None = None,
+                 connect_attempts: int | None = None,
+                 call_timeout: float | None = None):
         from tensorflowonspark_tpu.utils.envtune import env_int
-        from tensorflowonspark_tpu.utils.net import connect_with_backoff
 
         self.address = (address[0], int(address[1]))
         self._lock = threading.Lock()
+        self._authkey = authkey
+        self._connect_timeout = connect_timeout
         # Backoff on the dial (TOS_CONNECT_ATTEMPTS): a single-shot connect
         # fails hard during a coordinator restart window or early-boot race;
         # the elastic layer leans on clients riding that window out.
-        self._sock = connect_with_backoff(
-            self.address, timeout=connect_timeout,
-            attempts=env_int("TOS_CONNECT_ATTEMPTS", 3))
-        if authkey is not None:
+        self._connect_attempts = (env_int("TOS_CONNECT_ATTEMPTS", 3)
+                                  if connect_attempts is None
+                                  else int(connect_attempts))
+        # None = block indefinitely (barriers/reduces legitimately wait
+        # minutes).  The heartbeat channel passes a bound so a BLACKHOLED
+        # coordinator (packets dropped, not refused) surfaces as a timeout
+        # the self-fence logic can count, instead of wedging the liveness
+        # thread forever — the zombie asymmetry ISSUE 13 closes.
+        self._call_timeout = call_timeout
+        self._sock = self._dial()
+        self._gen = 0
+        self._executor_id: int | None = None
+        self._incarnation = 0
+        # last coordinator epoch observed on a reply (None until the first
+        # round-trip); a bump is flight-recorded once per change
+        self.epoch: int | None = None
+        # latest clock estimate from a heartbeat round-trip (driver-mono =
+        # local-mono + offset, midpoint method); the node's heartbeat loop
+        # feeds the best of these to the tracer for timeline merging
+        self.last_clock_offset: float | None = None
+        self.last_rtt: float | None = None
+
+    def _dial(self) -> socket.socket:
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+
+        sock = connect_with_backoff(
+            self.address, timeout=self._connect_timeout,
+            attempts=self._connect_attempts)
+        if self._authkey is not None:
             from tensorflowonspark_tpu.utils.net import hmac_handshake_client
 
             # connect_timeout still governs the socket here, so a server
             # that never sends a nonce (authkey=None config mismatch) fails
             # within it rather than hanging; close the fd on ANY failure.
             try:
-                accepted = hmac_handshake_client(self._sock, authkey)
+                accepted = hmac_handshake_client(sock, self._authkey)
             except (OSError, ConnectionError) as e:
-                self._sock.close()
+                sock.close()
                 raise ConnectionError(
                     f"coordinator handshake failed ({e}); authkey mismatch or "
                     "unauthenticated server?") from e
             if not accepted:
-                self._sock.close()
+                sock.close()
                 raise ConnectionError("coordinator rejected authkey")
-        self._sock.settimeout(None)
-        self._gen = 0
-        self._executor_id: int | None = None
-        self._incarnation = 0
-        # latest clock estimate from a heartbeat round-trip (driver-mono =
-        # local-mono + offset, midpoint method); the node's heartbeat loop
-        # feeds the best of these to the tracer for timeline merging
-        self.last_clock_offset: float | None = None
-        self.last_rtt: float | None = None
+        sock.settimeout(self._call_timeout)
+        return sock
+
+    def _reconnect_locked(self) -> None:
+        """Redial (with backoff) after a broken connection — the coordinator
+        may be mid-supervised-restart; caller holds ``_lock``."""
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        self._sock = self._dial()
 
     def set_identity(self, executor_id: int, incarnation: int = 0) -> None:
         """Adopt the registration-assigned identity: every subsequent message
@@ -1040,16 +1519,60 @@ class CoordinatorClient:
         if self._executor_id is not None and msg.get("op") != "register":
             msg.setdefault("executor_id", self._executor_id)
             msg.setdefault("incarnation", self._incarnation)
+        if self.epoch is not None:
+            # epoch fencing: the server rejects barrier/reduce requests
+            # composed against a pre-crash epoch (stale_epoch reply)
+            msg.setdefault("coordinator_epoch", self.epoch)
         return msg
 
-    def _call(self, msg: dict) -> dict:
+    def _note_epoch(self, resp: dict) -> None:
+        ep = resp.get("epoch")
+        if ep is None:
+            return
+        ep = int(ep)
+        if self.epoch is not None and ep > self.epoch:
+            ttrace.event("coordinator_epoch", epoch=ep,
+                         executor=self._executor_id)
+            logger.warning("coordinator epoch %d -> %d: the control plane "
+                           "restarted; re-asserting over this connection",
+                           self.epoch, ep)
+        if self.epoch is None or ep > self.epoch:
+            self.epoch = ep
+
+    def _call(self, msg: dict, retry: bool = False) -> dict:
+        """One request/reply round-trip.  On a broken connection the client
+        reconnects with backoff either way; ``retry=True`` (idempotent ops
+        only) then resends the request, while ``retry=False`` raises
+        :class:`CoordinatorRestarted` — a non-idempotent request may have
+        been served before the connection died, and blind replay could
+        join (and corrupt) a fresh rendezvous generation."""
         msg = self._stamp(msg)
         with self._lock:
-            _send_msg(self._sock, msg)
-            return _recv_msg(self._sock)
+            try:
+                _send_msg(self._sock, msg)
+                resp = _recv_msg(self._sock)
+            except (ConnectionError, OSError, ValueError) as e:
+                try:
+                    self._reconnect_locked()
+                except Exception as e2:
+                    raise ConnectionError(
+                        f"coordinator unreachable ({e2}); original failure: "
+                        f"{e}") from e
+                if not retry:
+                    raise CoordinatorRestarted(
+                        f"control-plane connection lost mid-call ({e}); "
+                        "reconnected, but a non-idempotent op is never "
+                        "replayed — re-enter at the caller's barrier") from e
+                _send_msg(self._sock, msg)
+                resp = _recv_msg(self._sock)
+        self._note_epoch(resp)
+        return resp
 
     def _check(self, resp: dict) -> dict:
         if not resp.get("ok"):
+            if resp.get("stale_epoch"):
+                raise CoordinatorRestarted(
+                    f"coordinator error: {resp.get('error')}")
             raise RuntimeError(f"coordinator error: {resp.get('error')}")
         return resp
 
@@ -1066,8 +1589,8 @@ class CoordinatorClient:
         """Poll QUERY until all nodes registered, then fetch cluster info (QINFO)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            if self._check(self._call({"op": "query"}))["complete"]:
-                return self._check(self._call({"op": "cluster_info"}))["nodes"]
+            if self._check(self._call({"op": "query"}, retry=True))["complete"]:
+                return self._check(self._call({"op": "cluster_info"}, retry=True))["nodes"]
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("cluster did not complete in time")
             time.sleep(poll)
@@ -1134,7 +1657,7 @@ class CoordinatorClient:
 
     def update_meta(self, executor_id: int, patch: dict) -> None:
         """Patch this node's registered metadata (e.g. tensorboard URL)."""
-        self._check(self._call({"op": "update_meta", "executor_id": executor_id, "patch": patch}))
+        self._check(self._call({"op": "update_meta", "executor_id": executor_id, "patch": patch}, retry=True))
 
     def heartbeat(self, executor_id: int, metrics: dict | None = None,
                   trace: dict | None = None) -> bool:
@@ -1152,7 +1675,7 @@ class CoordinatorClient:
         if trace:
             msg["trace"] = trace
         t0 = time.monotonic()
-        resp = self._check(self._call(msg))
+        resp = self._check(self._call(msg, retry=True))
         t1 = time.monotonic()
         server_now = resp.get("now")
         if server_now is not None:
@@ -1162,21 +1685,22 @@ class CoordinatorClient:
 
     def metrics(self) -> dict:
         """Aggregated cluster metrics snapshot (the ``metrics`` op)."""
-        return self._check(self._call({"op": "metrics"}))["snapshot"]
+        return self._check(self._call({"op": "metrics"}, retry=True))["snapshot"]
 
     def stats(self, window: float = 10.0) -> dict:
         """Rolling-window cluster stats (the ``statz`` op): live qps /
         p50/p99 / queue depths over the last ``window`` seconds."""
-        return self._check(self._call({"op": "statz",
-                                       "window": float(window)}))["stats"]
+        return self._check(self._call({"op": "statz", "window": float(window)},
+                                       retry=True))["stats"]
 
     def manifest(self) -> dict:
         """The driver-published DIRECT-mode job manifest (empty dict until
         a DIRECT train() publishes one)."""
-        return self._check(self._call({"op": "manifest"}))["manifest"]
+        return self._check(self._call({"op": "manifest"}, retry=True))["manifest"]
 
     def report_error(self, executor_id: int, traceback_str: str) -> None:
-        self._call({"op": "error", "executor_id": executor_id, "traceback": traceback_str})
+        self._call({"op": "error", "executor_id": executor_id,
+                    "traceback": traceback_str}, retry=True)
 
     def deregister(self, executor_id: int, metrics: dict | None = None,
                    trace: dict | None = None) -> None:
@@ -1188,10 +1712,10 @@ class CoordinatorClient:
             msg["metrics"] = metrics
         if trace:
             msg["trace"] = trace
-        self._call(msg)
+        self._call(msg, retry=True)
 
     def request_stop(self) -> None:
-        self._call({"op": "stop"})
+        self._call({"op": "stop"}, retry=True)
 
     def close(self) -> None:
         try:

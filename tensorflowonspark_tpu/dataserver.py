@@ -389,7 +389,10 @@ class DataServer:
             # back (old server) stays on v1; see WIRE_VERSION
             return ("ok", min(WIRE_VERSION, int(msg[1])))
         if op in ("feed", "infer_send", "infer_round"):
-            # may raise FaultInjected when a `sever` action is armed
+            # chaos seams: `delay_net:ms=M` injects wire latency on every
+            # data-carrying op; `sever`/`flap` may raise FaultInjected so
+            # the connection closes with no reply
+            faultinject.net_delay()
             faultinject.data_op()
         if op == "feed":
             _, qname, items = msg

@@ -23,12 +23,29 @@ deterministically while its peers stay healthy):
   Models a preemption mid-gradient-exchange: partial chunks in flight,
   peers blocked in the same round — survivors must abort at the generation
   barrier and the restart must rejoin (``collective/group.py``).
+- ``kill_coordinator`` — crash the control-plane server on its N-th
+  dispatched op (hook: ``coordinator.CoordinatorServer._dispatch``): every
+  connection severed, in-memory state wiped, the request in flight never
+  answered.  The journaled-recovery path (``journal.py`` + the coordinator
+  supervisor) must replay and resume under a bumped epoch.  Armed in the
+  DRIVER process (the coordinator lives there).
+- ``delay_net:ms=M`` — network degradation: injects M milliseconds of
+  latency on every control-plane send (``coordinator._send_msg``) and every
+  data-carrying server op (``dataserver``) in the armed process, for as
+  long as the process lives.
+- ``flap:period=S`` — periodic network flapping: during every ODD
+  S-second window since arming, this node's liveness pings are swallowed
+  (zombie phase) and its first data-carrying op of the window severs the
+  connection; even windows are healthy (re-admit phase).  Wall-clock
+  driven by design — the action models link flap, not a counted event.
 
 Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
 ``name:key=value,key=value`` —
 
     TOS_FAULTINJECT="kill:after_batches=3,incarnation=0"
     TOS_FAULTINJECT="drop_heartbeats:count=8;sever:after_data_ops=2"
+    TOS_FAULTINJECT="kill_coordinator:after_ops=40"
+    TOS_FAULTINJECT="delay_net:ms=5;flap:period=2"
 
 Common keys: ``executor=E`` fires only on that executor id (ids are assigned
 at registration, so per-node targeting usually rides ``per_node_env``
@@ -44,6 +61,7 @@ import logging
 import os
 import signal
 import threading
+import time
 
 logger = logging.getLogger(__name__)
 
@@ -56,7 +74,8 @@ class FaultInjected(Exception):
 
 
 class _Action:
-    __slots__ = ("name", "threshold", "executor", "incarnation", "fired", "count")
+    __slots__ = ("name", "threshold", "executor", "incarnation", "fired",
+                 "count", "hb_cycle", "sever_cycle")
 
     def __init__(self, name: str, threshold: int,
                  executor: int | None, incarnation: int | None):
@@ -66,6 +85,10 @@ class _Action:
         self.incarnation = incarnation
         self.fired = False
         self.count = 0
+        # flap bookkeeping: last down-window index counted / severed, so
+        # each odd window is metered once and severs exactly one connection
+        self.hb_cycle = -1
+        self.sever_cycle = -1
 
 
 class FaultPlan:
@@ -78,18 +101,30 @@ class FaultPlan:
              # the first chunk exchange (ops.py), so partial gradient chunks
              # are genuinely in flight when the process dies — the round the
              # generation-barrier rejoin must fence and survive
-             "kill_collective": "after_rounds"}
+             "kill_collective": "after_rounds",
+             # crash the control-plane server on its Nth dispatched op
+             # (coordinator._dispatch) — the journaled-recovery chaos clock
+             "kill_coordinator": "after_ops",
+             # continuous network degradation: the "threshold" is the
+             # parameter (ms of latency / seconds of flap period), not a
+             # count — see _CONTINUOUS
+             "delay_net": "ms",
+             "flap": "period"}
     # one-shot actions fire once when the counter REACHES the threshold;
     # windowed actions fire on EVERY call until the threshold is spent
     # (drop_heartbeats swallows the first K pings — one dropped ping would
     # never outlast the driver's dead-node timeout)
     _WINDOWED = frozenset({"drop_heartbeats"})
+    # continuous actions never "fire and disarm": they degrade the process
+    # for its whole life (delay_net) or on a periodic schedule (flap)
+    _CONTINUOUS = frozenset({"delay_net", "flap"})
 
     def __init__(self, actions: list[_Action]):
         self._lock = threading.Lock()
         self._actions = actions
         self._executor_id: int | None = None
         self._incarnation = 0
+        self._t0 = time.monotonic()  # flap phase anchor (arming time)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -140,6 +175,71 @@ class FaultPlan:
                     self._count_injection(name)
                     return True
         return False
+
+    def _armed(self, name: str) -> _Action | None:
+        """The identity-matched action of a CONTINUOUS kind, else None."""
+        with self._lock:
+            for a in self._actions:
+                if a.name != name:
+                    continue
+                if a.executor is not None and a.executor != self._executor_id:
+                    continue
+                if a.incarnation is not None and a.incarnation != self._incarnation:
+                    continue
+                return a
+        return None
+
+    def delay_ms(self) -> int:
+        """Injected per-send latency (``delay_net:ms=M``), 0 when unarmed.
+        Metered once at first delay (flight event) and per delayed send
+        (``faultinject.delayed_sends`` counter) — the caller sleeps."""
+        a = self._armed("delay_net")
+        if a is None:
+            return 0
+        with self._lock:
+            first = not a.fired
+            a.fired = True
+            a.count += 1
+        if first:
+            self._count_injection("delay_net")
+        return a.threshold
+
+    def _flap_window(self, a: _Action) -> tuple[int, bool]:
+        """(window index since arming, is this a DOWN window)."""
+        period = max(1, a.threshold)
+        cycle = int((time.monotonic() - self._t0) // period)
+        return cycle, cycle % 2 == 1
+
+    def flap_down(self) -> bool:
+        """True while inside a flap DOWN window (liveness pings swallowed);
+        each down window is metered once."""
+        a = self._armed("flap")
+        if a is None:
+            return False
+        cycle, down = self._flap_window(a)
+        if down:
+            with self._lock:
+                count = a.hb_cycle != cycle
+                a.hb_cycle = cycle
+            if count:
+                self._count_injection("flap")
+        return down
+
+    def flap_sever(self) -> bool:
+        """True exactly once per flap DOWN window on the data plane: the
+        window's first data-carrying op severs its connection; the rest of
+        the window (and every even window) passes — the re-admit phase."""
+        a = self._armed("flap")
+        if a is None:
+            return False
+        cycle, down = self._flap_window(a)
+        if not down:
+            return False
+        with self._lock:
+            if a.sever_cycle == cycle:
+                return False
+            a.sever_cycle = cycle
+        return True
 
     @staticmethod
     def _count_injection(name: str) -> None:
@@ -230,12 +330,42 @@ def collective_round() -> None:
 
 
 def drop_heartbeat() -> bool:
-    """Hook: about to send a liveness ping; True = swallow it."""
-    return _PLAN is not None and _PLAN._tick("drop_heartbeats")
+    """Hook: about to send a liveness ping; True = swallow it (the counted
+    ``drop_heartbeats`` action, or a ``flap`` DOWN window)."""
+    if _PLAN is None:
+        return False
+    return _PLAN._tick("drop_heartbeats") or _PLAN.flap_down()
 
 
 def data_op() -> None:
     """Hook: a data-carrying op (feed / infer_send) reached the node's data
-    server; ``sever`` raises so the connection closes with no reply."""
-    if _PLAN is not None and _PLAN._tick("sever"):
+    server; ``sever`` (or the first op of a ``flap`` DOWN window) raises so
+    the connection closes with no reply."""
+    if _PLAN is None:
+        return
+    if _PLAN._tick("sever"):
         raise FaultInjected("severing data-plane connection (TOS_FAULTINJECT)")
+    if _PLAN.flap_sever():
+        raise FaultInjected("flap window severing data-plane connection "
+                            "(TOS_FAULTINJECT)")
+
+
+def coordinator_op() -> bool:
+    """Hook: a control-plane request reached the coordinator's dispatcher;
+    True = ``kill_coordinator`` fires now (the server crash()es itself —
+    the journaled-recovery path owns what happens next)."""
+    return _PLAN is not None and _PLAN._tick("kill_coordinator")
+
+
+def net_delay() -> None:
+    """Hook: about to send on the control plane (or serve a data op);
+    ``delay_net:ms=M`` sleeps M milliseconds here — injected wire latency
+    for the armed process."""
+    if _PLAN is None:
+        return
+    ms = _PLAN.delay_ms()
+    if ms:
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.counter("faultinject.delayed_sends").inc()
+        time.sleep(ms / 1000.0)

@@ -36,6 +36,7 @@ from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.marker import EndOfFeed, EndPartition, Marker, ResultChunk
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 from time import monotonic as _monotonic
+from time import sleep as _sleep
 
 
 class FeedQueues:
@@ -83,6 +84,17 @@ class FeedQueues:
     def set(self, key: str, value: Any) -> None:
         with self._lock:
             self._state[key] = value
+
+    def compare_and_set(self, key: str, expected: Any, value: Any) -> bool:
+        """Atomic state transition: set only when the current value matches
+        ``expected``.  The park/unpark ladder uses this so a self-fence can
+        never clobber the 'terminating' fast-drain state (stop beats park),
+        and an unpark never resurrects a feed that terminated meanwhile."""
+        with self._lock:
+            if self._state.get(key) != expected:
+                return False
+            self._state[key] = value
+            return True
 
     def get(self, key: str) -> Any:
         with self._lock:
@@ -190,6 +202,15 @@ class DataFeed:
 
         Reference hot loop ``TFNode.py:~280-340``.
         """
+        # Self-fence (ISSUE 13): "parked" means this node lost its
+        # coordinator past TOS_COORDINATOR_GRACE_SECS — a replacement may
+        # already own the slot, so taking NEW work risks split-brain.  Hold
+        # here (checked once per batch, off the per-item hot path) until
+        # the heartbeat loop re-admits us or gives up (stop_event).
+        while self.queues.get("state") == "parked":
+            if self.stop_event is not None and self.stop_event.is_set():
+                break
+            _sleep(self.poll_interval)
         for key in self._closed_unreported:
             self.queues.note_partition_consumed(self.qname_in, key)
         self._closed_unreported = []

@@ -37,6 +37,7 @@ import threading
 from typing import Any, Iterable
 
 from time import monotonic as _monotonic
+from time import sleep as _sleep
 
 from tensorflowonspark_tpu import faultinject, telemetry
 from tensorflowonspark_tpu.feeding import FeedQueues, batch_to_columns
@@ -263,6 +264,14 @@ class IngestFeed:
         inside a partition never truncate it) / a columnar chunk boundary.
         Calling this RELEASES the previous batch (see the zero-copy decode
         contract in the class docstring)."""
+        # Self-fence (ISSUE 13): parked = coordinator unreachable past
+        # TOS_COORDINATOR_GRACE_SECS — stop taking new ledger work until
+        # the heartbeat loop re-admits us or gives up (same contract as
+        # the streaming DataFeed; checked once per batch).
+        while self.queues.get("state") == "parked":
+            if self.stop_event is not None and self.stop_event.is_set():
+                break
+            _sleep(self.poll_interval)
         if self._prev_views:
             # debug zero-copy: the previous batch retires NOW — releasing
             # its views makes any retained one fail loudly at first touch

@@ -480,43 +480,38 @@ def node_main(config: NodeConfig) -> int:
     stop_requested = threading.Event()
 
     def _heartbeat_loop() -> None:
-        from tensorflowonspark_tpu.dataserver import _force_put
-
-        # Heartbeats are load-bearing for liveness now (the driver's monitor
-        # flags silent nodes dead): a transient connect failure must retry,
-        # and a persistent one must stop this node deliberately — silently
-        # training on with no heartbeat channel would get the whole job
-        # killed ~12s later with a misleading "node died" error.
-        hb_client = None
-        for attempt in range(3):
-            try:
-                hb_client = CoordinatorClient(config.coordinator_addr,
-                                              authkey=config.authkey,
-                                              connect_timeout=3.0)
-                hb_client.set_identity(executor_id, incarnation)
-                break
-            except Exception:
-                time.sleep(0.5 * (attempt + 1))
-        if hb_client is None:
-            # Must NOT stop silently: a clean exit here would deregister and
-            # drop this node's partitions with no error anywhere (silent
-            # data loss).  Report through the main client (thread-safe) so
-            # train()/shutdown() raise, THEN drain.
-            msg = ("heartbeat channel could not connect after retries; "
-                   "node cannot participate in liveness tracking")
-            logger.error(msg)
-            try:
-                client.report_error(executor_id, msg)
-            except Exception:
-                logger.debug("could not deliver the heartbeat-channel "
-                             "failure report either", exc_info=True)
-            _enter_stop_state()
-            return
         from tensorflowonspark_tpu import telemetry
         from tensorflowonspark_tpu.telemetry import trace as ttrace
+        from tensorflowonspark_tpu.utils.envtune import env_float
 
+        # Heartbeats are load-bearing for liveness (the driver's monitor
+        # flags silent nodes dead) AND for the client-side SELF-FENCE
+        # (ISSUE 13): a node that cannot reach the coordinator for longer
+        # than TOS_COORDINATOR_GRACE_SECS must not keep computing as a
+        # zombie — once the driver's death-declaration window expires, a
+        # replacement may own this slot, and split-brain writes (outputs,
+        # checkpoints) are exactly what incarnation fencing exists to
+        # prevent.  Timeline on sustained silence:
+        #   0 .. grace      — redial every interval (a supervised
+        #                     coordinator restart lands well inside this);
+        #   grace ..        — PARK: the feeds stop taking new work
+        #                     ("parked" queue state) until a successful
+        #                     ping re-admits us (or a fenced reply says
+        #                     stop, i.e. re-registration owns the slot);
+        #   4 x grace       — give up: force end-of-feed and exit (the
+        #                     driver is gone for good).
+        # The heartbeat channel dials single-shot with a BOUNDED call
+        # timeout so a blackholed (packets dropped, not refused)
+        # coordinator surfaces as a timeout this loop can count, instead
+        # of wedging the liveness thread forever — the zombie asymmetry
+        # this satellite closes.
+        grace = env_float("TOS_COORDINATOR_GRACE_SECS",
+                          max(12.0, 6.0 * config.heartbeat_interval))
         tracer = ttrace.get_tracer()
-        failures = 0
+        hb_client = None
+        parked = False
+        ever_ok = False
+        last_ok = time.monotonic()
         metrics_state: dict | None = None
         while not stop_requested.is_set():
             if faultinject.drop_heartbeat():
@@ -525,15 +520,22 @@ def node_main(config: NodeConfig) -> int:
                 # will declare dead; incarnation fencing handles the rest).
                 time.sleep(config.heartbeat_interval)
                 continue
+            payload: dict | None = None
+            trace_payload: dict | None = None
+            stop = False
             try:
+                if hb_client is None:
+                    hb_client = CoordinatorClient(
+                        config.coordinator_addr, authkey=config.authkey,
+                        connect_timeout=3.0, connect_attempts=1,
+                        call_timeout=max(5.0, min(grace, 15.0)))
+                    hb_client.set_identity(executor_id, incarnation)
                 # Compact telemetry delta piggybacks on the ping (absolute
                 # cumulative values, changed keys only): the cluster metrics
                 # transport costs zero extra round-trips, and a delta lost
                 # with a failed ping is re-sent implicitly by the next one.
                 # The trace delta (new spans + flight events, stamped with
                 # the current clock-offset estimate) rides the same ping.
-                payload: dict | None = None
-                trace_payload: dict | None = None
                 if telemetry.enabled():
                     payload, metrics_state = telemetry.collect_changed(
                         metrics_state)
@@ -546,9 +548,20 @@ def node_main(config: NodeConfig) -> int:
                 if hb_client.last_clock_offset is not None:
                     tracer.note_clock(hb_client.last_clock_offset,
                                       hb_client.last_rtt)
-                failures = 0
+                ever_ok = True
+                last_ok = time.monotonic()
+                if parked:
+                    # re-admitted: the coordinator (possibly a journal-
+                    # recovered one at a bumped epoch) answered our ping
+                    # without fencing us — resume taking ledger work.
+                    # compare_and_set: a feed that TERMINATED while parked
+                    # keeps its fast-drain state (stop beats park).
+                    parked = False
+                    queues.compare_and_set("state", "parked", "running")
+                    ttrace.event("readmit", executor=executor_id)
+                    logger.warning("coordinator reachable again; node %d "
+                                   "unparked", executor_id)
             except Exception:
-                failures += 1
                 # the delta that rode the failed ping may be lost: drop the
                 # dedupe state so the next successful ping re-sends a full
                 # snapshot (values are absolute — re-sending is idempotent),
@@ -559,17 +572,57 @@ def node_main(config: NodeConfig) -> int:
                 if payload:
                     telemetry.get_registry().restore_recent(payload)
                 tracer.restore_delta(trace_payload)
-                if failures >= 3:
-                    # Coordinator gone (driver exited/crashed): treat exactly
-                    # like a stop signal so map_fun unblocks instead of
-                    # wedging on the feed until the launcher SIGTERMs us
-                    # (reference feed_timeout semantics,
-                    # TFSparkNode.py:~460-490).
-                    logger.warning("coordinator unreachable after %d heartbeats; "
-                                   "forcing end-of-feed", failures)
+                if hb_client is not None:
+                    try:
+                        hb_client.close()
+                    except OSError:  # toslint: allow-silent(socket already dead; a fresh dial follows)
+                        pass
+                    hb_client = None
+                silent = time.monotonic() - last_ok
+                # a channel that NEVER connected fails fast at one grace —
+                # the driver's monitor declares this node dead at
+                # TOS_DEAD_NODE_TIMEOUT with a generic death error, so the
+                # specific report below must beat the 4x-grace ladder
+                # (riding out a coordinator restart window still fits: the
+                # supervisor backoff is well under one grace)
+                give_up_at = grace if not ever_ok else 4.0 * grace
+                if silent > give_up_at:
+                    logger.error(
+                        "coordinator unreachable for %.0fs (budget %.0fs, "
+                        "TOS_COORDINATOR_GRACE_SECS=%.0fs); forcing "
+                        "end-of-feed", silent, give_up_at, grace)
+                    if not ever_ok:
+                        # never had a liveness channel at all: a clean exit
+                        # would deregister and silently drop this node's
+                        # partitions — report through the main client
+                        # (thread-safe) so train()/shutdown() raise
+                        try:
+                            client.report_error(
+                                executor_id,
+                                "heartbeat channel never connected; node "
+                                "cannot participate in liveness tracking")
+                        except Exception:
+                            logger.debug("could not deliver the heartbeat-"
+                                         "channel failure report either",
+                                         exc_info=True)
                     _enter_stop_state()
                     return
-                stop = False
+                if not parked and silent > grace:
+                    # SELF-FENCE: past the grace the driver has (or soon
+                    # will have) declared us dead and re-fed our work —
+                    # stop accepting new ledger work and park until a
+                    # heartbeat round-trip re-admits (or fences) us.
+                    # compare_and_set: never clobber a 'terminating' feed's
+                    # fast-drain state — a stopped node has nothing to fence.
+                    parked = True
+                    queues.compare_and_set("state", "running", "parked")
+                    ttrace.event("self_fence", executor=executor_id,
+                                 silent_secs=round(silent, 1))
+                    logger.warning(
+                        "coordinator unreachable for %.1fs (> "
+                        "TOS_COORDINATOR_GRACE_SECS=%.0fs); node %d "
+                        "self-fenced: parked, no new ledger work until "
+                        "re-admitted", silent, grace, executor_id)
             if stop:
                 # Driver asked us to stop: unblock any DataFeed consumer so
                 # map_fun can exit (zombie-free teardown, SURVEY.md §7.3-5).
@@ -578,6 +631,8 @@ def node_main(config: NodeConfig) -> int:
             time.sleep(config.heartbeat_interval)
 
     def _enter_stop_state() -> None:
+        from tensorflowonspark_tpu.dataserver import _force_put
+
         stop_requested.set()
         # fast-drain: in-flight and future driver feed puts return
         # "terminating" instead of blocking on a consumer that may be

@@ -36,6 +36,7 @@ workers are idle.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import logging
 import threading
 from time import monotonic as _monotonic
@@ -83,6 +84,12 @@ def _load(rep: _Replica) -> int:
     return len(rep.queue) + rep.inflight
 
 
+# monotone per-process router id: the key each router publishes its serving
+# replica set under in the coordinator's journal-backed registry
+# (itertools.count: gateways can be opened from concurrent threads)
+_ROUTER_SEQ = itertools.count(1)
+
+
 class ReplicaRouter:
     """Dispatch micro-batches to the cluster's serving replicas."""
 
@@ -105,6 +112,11 @@ class ReplicaRouter:
         self._resync_seq = 0  # recovery-thread only; nonces for _resync
         self._replicas: dict[int, _Replica] = {
             eid: _Replica(eid) for eid in cluster._feed_ids}
+        # journal-backed serving registry (ISSUE 13): this router's healthy
+        # replica set, published to the coordinator whenever it changes so
+        # a control-plane failover restores who was serving
+        self._registry_name = f"router{next(_ROUTER_SEQ)}"
+        self._published: list[int] | None = None
         self._healthy_gauge = telemetry.gauge("serve.replicas_healthy")
         self._draining_gauge = telemetry.gauge("serve.replicas_draining")
         self._outstanding_gauge = telemetry.gauge("serve.inflight_batches")
@@ -118,6 +130,23 @@ class ReplicaRouter:
         self._recovery = threading.Thread(target=self._recovery_loop,
                                           daemon=True, name="serve-recovery")
         self._recovery.start()
+        self._publish_registry()
+
+    def _publish_registry(self) -> None:
+        """Best-effort publish of this router's healthy replica set to the
+        coordinator's journal-backed serving registry (no-op changes are
+        deduped).  Never on a hot path; never raises — the registry is
+        failover evidence, not routing state."""
+        coord = getattr(self._cluster, "coordinator", None)
+        if coord is None or not hasattr(coord, "note_serving_replicas"):
+            return
+        try:
+            healthy = self.healthy_replicas()
+            if healthy != self._published:
+                self._published = healthy
+                coord.note_serving_replicas(self._registry_name, healthy)
+        except Exception:  # noqa: BLE001 - registry publish must not break serving
+            logger.debug("serving-registry publish failed", exc_info=True)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -300,6 +329,10 @@ class ReplicaRouter:
                         if not r.healthy and not r.draining]
             for rep in down:
                 self._try_recover(rep)
+            # keep the coordinator's journal-backed registry current with
+            # whatever membership changes this pass (or a death elsewhere)
+            # produced — the tick is the change-coalescing boundary
+            self._publish_registry()
             with self._cond:
                 if self._stop:
                     return
@@ -496,6 +529,7 @@ class ReplicaRouter:
         rep.thread.start()
         ttrace.event("replica_added", executor=executor_id)
         logger.info("serving replica %d admitted into routing", executor_id)
+        self._publish_registry()
         return True
 
     def retire_replica(self, executor_id: int, timeout: float = 60.0) -> bool:
@@ -559,6 +593,7 @@ class ReplicaRouter:
         logger.info("serving replica %d drained out of routing%s",
                     executor_id,
                     "" if clean else " (drain timed out; queue rerouted)")
+        self._publish_registry()
         return clean and not leftovers
 
     # -- lifecycle -----------------------------------------------------------
@@ -584,3 +619,9 @@ class ReplicaRouter:
                     rep.client.close()
                 rep.client = None
         self._recovery.join(timeout=10.0)
+        # retract this router's registry entry: a closed gateway must not
+        # keep presenting healthy replicas in statz / post-failover replay
+        coord = getattr(self._cluster, "coordinator", None)
+        if coord is not None and hasattr(coord, "note_serving_replicas"):
+            with contextlib.suppress(Exception):
+                coord.note_serving_replicas(self._registry_name, [])

@@ -69,6 +69,105 @@ class RestartPolicy:
                              self.backoff_max, self.jitter)
 
 
+class CoordinatorSupervisor:
+    """Supervised restart of the control-plane server ITSELF (ISSUE 13).
+
+    The coordinator was the last unsupervised failure domain: node death,
+    severed sockets, and mid-drain kills all recover, but a coordinator
+    crash used to kill the run.  With the write-ahead journal
+    (``journal.py``) the server can be rebuilt from disk; this class reuses
+    the node supervisor's budgeted-backoff machinery (same
+    :class:`RestartPolicy` / ``TOS_MAX_RESTARTS`` / ``TOS_RESTART_BACKOFF_*``
+    knobs) to drive ``CoordinatorServer.restore()`` after a ``crash()``:
+    wait out a jittered backoff, replay the journal, resume under a bumped
+    coordinator epoch.  Budget exhausted (or restore itself raising past
+    the budget) fails the run through the node-error channel — the
+    non-supervised fail-fast behaviour, delayed by the budget, not removed.
+    """
+
+    def __init__(self, server, policy: RestartPolicy | None = None):
+        self.server = server
+        self.policy = policy or RestartPolicy.from_env()
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._restarts = 0
+        self._permanent: str | None = None
+        self._inflight = False
+        self._threads: list[threading.Thread] = []
+        server.add_crash_listener(self._on_crash)
+
+    def restart_count(self) -> int:
+        with self._lock:
+            return self._restarts
+
+    def permanently_failed(self) -> str | None:
+        with self._lock:
+            return self._permanent
+
+    def _on_crash(self) -> None:
+        if self._stopped.is_set():
+            return
+        with self._lock:
+            if self._inflight or self._permanent is not None:
+                return
+            self._inflight = True
+            t = threading.Thread(target=self._recover, daemon=True,
+                                 name="coordinator-supervisor")
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+        t.start()
+
+    def _recover(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    attempt = self._restarts
+                if attempt >= self.policy.max_restarts:
+                    self._fail_permanently(
+                        f"coordinator exhausted its restart budget "
+                        f"({self.policy.max_restarts} restart(s)); giving up")
+                    return
+                delay = self.policy.delay(attempt)
+                logger.warning("restarting coordinator in %.2fs "
+                               "(attempt %d/%d)", delay, attempt + 1,
+                               self.policy.max_restarts)
+                if self._stopped.wait(delay):
+                    return
+                with self._lock:
+                    self._restarts = attempt + 1
+                try:
+                    self.server.restore()
+                    return
+                except Exception:
+                    logger.exception("coordinator restore failed; spending "
+                                     "another budget unit")
+        finally:
+            with self._lock:
+                self._inflight = False
+
+    def _fail_permanently(self, reason: str) -> None:
+        telemetry.counter("coordinator.permanent_failures").inc()
+        ttrace.event("permanent_failure", executor=-1, reason=reason[:200])
+        logger.error("control plane permanently failed: %s", reason)
+        # surface through the node-error channel (executor -1 = the control
+        # plane itself) so shutdown()'s error propagation raises it; the
+        # _permanent flag is set LAST — it is the observable "verdict is in"
+        # signal, and a watcher acting on it must find the error recorded
+        self.server.record_failure(
+            -1, f"control plane permanently failed: {reason}")
+        self.server.signal_stop()
+        with self._lock:
+            self._permanent = reason
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """No coordinator restarts past this point (shutdown owns teardown)."""
+        self._stopped.set()
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout)
+
+
 class Supervisor:
     """Watches launcher children and restarts failed nodes under a policy."""
 

@@ -61,6 +61,13 @@ _ALL = (
     Knob("TOS_CONNECT_ATTEMPTS", "int", "3",
          "Dial attempts (with backoff + jitter) for control/data-plane "
          "clients before a connection error surfaces."),
+    Knob("TOS_COORDINATOR_GRACE_SECS", "float",
+         "max(12, 6 x heartbeat_interval)",
+         "Node-side self-fence: heartbeat silence (seconds) after which a "
+         "node stops accepting new ledger work and PARKS (a replacement "
+         "may own its slot); at 4x this budget the node gives up and "
+         "exits.  A supervised coordinator restart re-admits parked nodes "
+         "on the next successful ping."),
     Knob("TOS_COORDINATOR_HOST", "str", "(bind all, advertise local_ip())",
          "Interface an *authenticated* coordinator binds and advertises; "
          "ignored without an authkey (loopback-only then)."),

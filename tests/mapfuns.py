@@ -493,6 +493,10 @@ def direct_record_counter(args, ctx):
             f.write("".join(str(rec, "utf-8") + "\n" for rec in batch))
             f.flush()
             n += len(batch)
+            if args.get("sleep_per_batch"):
+                # chaos pacing: keep the feed in flight long enough for a
+                # mid-train fault to land deterministically
+                time.sleep(args["sleep_per_batch"])
     ctx.update_meta({f"records_inc{ctx.incarnation}": n,
                      "manifest": ctx.job_manifest()})
 
@@ -642,6 +646,104 @@ def chaos_batch(rank, step, batch_size=8):
     x = (base * (1.0 + rank) + step) % 5.0
     y = (np.arange(batch_size, dtype=np.float32) + rank) % 3.0
     return {"x": x.astype(np.float32), "y": y.astype(np.float32)}
+
+
+def sync_coordinator_chaos(args, ctx):
+    """Fixed-step synchronous training with a per-step CONTROL-PLANE
+    barrier, surviving a coordinator crash (ISSUE 13): the barrier (or the
+    all-reduce a poisoned generation aborts) raises, everyone re-forms at
+    the next generation barrier against the journal-recovered coordinator
+    (CoordinatorClient reconnects with backoff; the form loop rides
+    ``CoordinatorRestarted``/epoch fencing), ``sync_state`` levels any
+    member that got one step ahead, and every node finishes at EXACTLY
+    ``args['steps']`` with params equal to the fault-free run.
+
+    The barrier runs BEFORE the train step so a member that failed it has
+    an unchanged state; a member whose barrier succeeded but whose
+    all-reduce then aborted is also unchanged (the apply half never runs on
+    an aborted exchange) — reform + sync_state therefore always agree."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.collective import CollectiveAborted
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    total = int(args["steps"])
+    # bounded collective timeout: a member whose peer is mid-reform must
+    # abort its own round and re-enter the barrier in seconds, not ride
+    # out the production 120s budget — this also scales the comm-flight
+    # (2t+30) and reform-drain (t+30) backstops, which bound how long one
+    # wedged broadcast/all-reduce cycle can cost during convergence
+    group = ctx.collective_group(name="coordchaos", timeout=10.0)
+    step = group.form(resume_step=0)
+    optimizer = optax.sgd(0.125)
+    state = dplib.TrainState.create(
+        {"w": np.full((3, 1), 0.25, np.float32)}, optimizer)
+    state, step = group.sync_state(state, step)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        err = pred[:, 0] - batch["y"]
+        return jnp.mean(err * err), {}
+
+    train = dplib.make_train_step(loss_fn, optimizer,
+                                  cross_host_grad_fn=group.grad_fn())
+    reforms = 0
+    epochs_seen = set()
+
+    def recover(cur_state, cur_step):
+        # re-form until it sticks: a reform attempted WHILE the coordinator
+        # is still mid-restore (or while a loaded box stretches the form
+        # budget) aborts and must simply be re-entered — the run only
+        # fails once the overall budget is truly gone.  Generous on
+        # purpose: worst-case convergence stacks a wedged peer flight
+        # (2t+30) on a drain backstop (t+30) before the barrier aligns.
+        deadline = time.monotonic() + 240.0
+        while True:
+            try:
+                group.reform(resume_step=cur_step)
+                return group.sync_state(cur_state, cur_step)
+            except (CollectiveAborted, RuntimeError, ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    while step < total:
+        batch = chaos_batch(group.rank, step)
+        try:
+            # per-step control-plane sync point: the op the coordinator
+            # crash poisons.  Short timeout: a peer already re-forming
+            # never joins this generation, so ride it out fast.
+            group.barrier(timeout=8.0)
+            state, _metrics = train(state, batch)
+        except (CollectiveAborted, RuntimeError, ConnectionError):
+            state, step = recover(state, step)
+            reforms += 1
+            continue
+        step += 1
+        if group._client.epoch is not None:
+            epochs_seen.add(group._client.epoch)
+        if args.get("step_delay"):
+            time.sleep(args["step_delay"])
+    while True:
+        try:
+            group.barrier(timeout=8.0)
+            break
+        except (CollectiveAborted, RuntimeError, ConnectionError):
+            # a crash landing on the FINAL barrier: re-form so the peer
+            # (which may be re-forming) can meet us, then re-enter
+            state, step = recover(state, step)
+            reforms += 1
+    ctx.update_meta({"coord_chaos": {
+        "rank": group.rank, "steps": step, "reforms": reforms,
+        "generation": group.generation,
+        "epochs_seen": sorted(epochs_seen),
+        "final_w": np.asarray(
+            jax.device_get(state.params["w"])).ravel().tolist(),
+    }})
+    group.close()
 
 
 def sync_collective_chaos(args, ctx):

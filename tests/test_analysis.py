@@ -503,6 +503,52 @@ def test_shard_io_quiet_in_sanctioned_homes_and_on_non_shard_io():
     assert quiet == []
 
 
+# -- journal-write discipline (ISSUE 13) --------------------------------------
+
+
+def test_journal_discipline_fires_on_stray_fsync():
+    found = lint(
+        """
+        import os
+        def persist(fd):
+            os.fsync(fd)
+        """, f"{PKG}/somemod.py", "journal-discipline")
+    assert len(found) == 1 and "os.fsync" in found[0].message
+    assert "journal.py" in found[0].hint
+
+
+def test_journal_discipline_fires_on_journal_file_open():
+    found = lint(
+        """
+        import os
+        def peek(log_dir):
+            a = open(log_dir + "/coordinator.journal").read()
+            b = os.open(journal_path, os.O_WRONLY)
+            return a, b
+        """, f"{PKG}/somemod.py", "journal-discipline")
+    assert {f.anchor for f in found} == {"peek@open", "peek@os.open"}
+
+
+def test_journal_discipline_quiet_in_journal_py_and_on_non_journal_io():
+    src = """
+        import os
+        def append(fd, path):
+            os.write(fd, b"x")
+            os.fsync(fd)
+            return open(path + ".journal", "rb").read()
+        """
+    assert lint(src, f"{PKG}/journal.py", "journal-discipline") == []
+    quiet = lint(
+        """
+        import os
+        def f(path):
+            data = open(path, "rb").read()       # not journal-named
+            os.write(1, data)                    # write without fsync
+            return data
+        """, f"{PKG}/somemod.py", "journal-discipline")
+    assert quiet == []
+
+
 # -- silent-except discipline -------------------------------------------------
 
 

@@ -27,3 +27,21 @@ def test_bench_quick_exact_and_shape():
     assert result["ring_vs_naive_x"] > 0
     out = bench_collective.markdown_table(result)
     assert "ring" in out and "naive" in out
+
+
+def test_bench_r14_control_plane_smoke():
+    """ISSUE 13 satellite: the journal-compare and recovery cells must keep
+    producing sane numbers on tiny sizes — a recovery that loses slots or a
+    rendezvous that stops completing fails INSIDE the bench."""
+    result = bench_collective.bench_r14(rounds=20, tail_records=16,
+                                        repeats=2)
+    jc = result["journal_compare"]
+    assert jc["journal_off"]["p50_us"] > 0
+    assert jc["journal_on"]["p50_us"] > 0
+    rec = result["recovery"]
+    assert rec["replayed_slots"] == rec["slots"] == 8
+    assert rec["restore_ms_median"] > 0
+    assert rec["crash_to_first_rendezvous_ms_median"] >= \
+        rec["restore_ms_median"]
+    out = bench_collective.markdown_r14(result)
+    assert "journal cost" in out and "recovery" in out

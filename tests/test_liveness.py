@@ -29,10 +29,12 @@ import mapfuns
 
 
 def test_coordinator_death_unblocks_node(tmp_path):
-    """Driver dies mid-feed (no EOF ever sent): the node must notice via
-    heartbeat failures and exit within ~3 heartbeat intervals instead of
-    wedging on the empty feed (reference feed_timeout semantics,
-    ``TFSparkNode.py:~460-490``)."""
+    """Driver dies mid-feed (no EOF ever sent): the node must ride out the
+    self-fence grace (parking, then giving up at 4x
+    TOS_COORDINATOR_GRACE_SECS — tuned tight here) and exit on its own
+    instead of wedging on the empty feed (reference feed_timeout semantics,
+    ``TFSparkNode.py:~460-490``; the park-then-give-up ladder is ISSUE 13's
+    zombie self-fence)."""
     cluster = tos.run(
         mapfuns.sum_batches,
         {"out_dir": str(tmp_path), "batch_size": 4},
@@ -40,6 +42,8 @@ def test_coordinator_death_unblocks_node(tmp_path):
         input_mode=InputMode.STREAMING,
         reservation_timeout=60,
         heartbeat_interval=0.3,
+        # park at 1s of silence, give up (forced end-of-feed) at 4s
+        env={"TOS_COORDINATOR_GRACE_SECS": "1"},
     )
     client = cluster._client(0)
     client.feed_partition(range(10))  # node consumed a partition, now blocked
