@@ -212,6 +212,13 @@ _COLLECTIVE_SOCKET_CALLS = frozenset({
     "connect_with_backoff", "bound_socket", "create_connection", "socket",
 })
 _COLLECTIVE_TRANSPORT = "collective/transport.py"
+# Ingest-worker peer channels (the data-service tier's worker->trainer
+# chunk streams) are confined to the existing transport homes: ingest/
+# modules must speak through dataserver.DataClient/DataServer (authkey
+# handshake, v2/v3 framing, ring upgrade, poison-on-failure) — an ad-hoc
+# socket there would bypass authentication AND the at-least-once failure
+# contract the forwarder's re-route path implements.
+_INGEST_SOCKET_CALLS = _COLLECTIVE_SOCKET_CALLS
 
 
 @register_checker
@@ -228,6 +235,11 @@ class DialDisciplineChecker(Checker):
                        "collective/transport.py — it owns generation "
                        "stamping and the broken-connection abort cascade; "
                        "group.py/ops.py must go through PeerTransport")
+    ingest_hint = ("ingest-worker peer channels ride dataserver."
+                   "DataClient/DataServer (the transport homes): the "
+                   "authkey handshake, wire framing, and the forwarder's "
+                   "at-least-once re-route all live there — no raw "
+                   "sockets in ingest/")
 
     def check(self, mod: ModuleSource) -> Iterator[Finding]:
         if mod.path.endswith("utils/net.py"):
@@ -235,6 +247,7 @@ class DialDisciplineChecker(Checker):
         io_exempt = mod.path.endswith(_ZEROCOPY_IO_ALLOWED)
         collective_confined = ("/collective/" in mod.path
                                and not mod.path.endswith(_COLLECTIVE_TRANSPORT))
+        ingest_confined = "/ingest/" in mod.path
         for node, scope in _scoped_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -249,6 +262,17 @@ class DialDisciplineChecker(Checker):
                         "collective/transport.py bypasses the transport's "
                         "generation fencing and abort cascade",
                         self.collective_hint, f"{_qual(scope)}@{name}")
+                    continue
+            if ingest_confined:
+                name = (fq.rsplit(".", 1)[-1] if fq
+                        else _terminal_name(node.func))
+                if name in _INGEST_SOCKET_CALLS:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"ingest-worker peer socket ({name}()) in ingest/ "
+                        "bypasses the data-plane transport homes "
+                        "(authkey handshake + at-least-once re-route)",
+                        self.ingest_hint, f"{_qual(scope)}@{name}")
                     continue
             if fq == "socket.create_connection":
                 yield Finding(
@@ -445,8 +469,10 @@ _THREADED_BASENAMES = frozenset({
     "gateway.py", "batcher.py", "router.py",
     # the reactor frontend: completion threads hand replies to the reactor
     "frontend.py",
-    # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer
-    "readers.py", "feed.py",
+    # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer —
+    # and the data-service tier (service.py): reader threads tee into the
+    # shared ChunkCache while the forwarder thread serves from it
+    "readers.py", "feed.py", "service.py",
     # the autoscaling subsystem: the Autoscaler tick thread (loop.py) races
     # user stop()/report() calls, and the governor (policy.py) is mutated
     # from whatever thread drives decide()

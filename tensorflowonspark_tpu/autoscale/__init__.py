@@ -5,7 +5,8 @@ The mechanism (grow/shrink/rebalance a live cluster) lives in
 
 - :mod:`~tensorflowonspark_tpu.autoscale.policy` — pure stats->count
   policies (:class:`QueueDepthBandPolicy`, :class:`LatencyCeilingPolicy`,
-  :class:`RowsPerNodeFloorPolicy`) and the anti-flap
+  :class:`RowsPerNodeFloorPolicy`, the data-service tier's
+  :class:`IngestBacklogPolicy`) and the anti-flap
   :class:`HysteresisGovernor`;
 - :mod:`~tensorflowonspark_tpu.autoscale.loop` — the
   :class:`Autoscaler` thread composing them over a live cluster
@@ -15,6 +16,7 @@ The mechanism (grow/shrink/rebalance a live cluster) lives in
 from tensorflowonspark_tpu.autoscale.loop import Autoscaler
 from tensorflowonspark_tpu.autoscale.policy import (
     HysteresisGovernor,
+    IngestBacklogPolicy,
     LatencyCeilingPolicy,
     Policy,
     QueueDepthBandPolicy,
@@ -24,6 +26,7 @@ from tensorflowonspark_tpu.autoscale.policy import (
 __all__ = [
     "Autoscaler",
     "HysteresisGovernor",
+    "IngestBacklogPolicy",
     "LatencyCeilingPolicy",
     "Policy",
     "QueueDepthBandPolicy",

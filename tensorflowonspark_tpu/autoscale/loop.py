@@ -66,14 +66,30 @@ class Autoscaler:
     """
 
     def __init__(self, cluster, policy: Policy | None = None, *,
+                 tier: str = "nodes",
                  min_nodes: int | None = None, max_nodes: int | None = None,
                  tick_secs: float | None = None,
                  cooldown_secs: float | None = None,
                  scale_in_ticks: int = 3,
                  window: float | None = None,
                  drain_timeout: float | None = None):
+        if tier not in ("nodes", "ingest"):
+            raise ValueError(f"tier must be 'nodes' or 'ingest', got {tier!r}")
         self._cluster = cluster
-        self.policy = policy or QueueDepthBandPolicy()
+        # tier="ingest" scales the DATA-SERVICE pool (cluster.resize_ingest
+        # over num_ingest) on the feed starvation signals; the default tier
+        # scales the trainer/serving fleet exactly as before
+        self.tier = tier
+        if policy is None:
+            if tier == "ingest":
+                from tensorflowonspark_tpu.autoscale.policy import (
+                    IngestBacklogPolicy,
+                )
+
+                policy = IngestBacklogPolicy()
+            else:
+                policy = QueueDepthBandPolicy()
+        self.policy = policy
         self.tick_secs = (float(tick_secs) if tick_secs is not None
                           else env_float("TOS_AUTOSCALE_TICK_SECS", 5.0))
         cooldown = (float(cooldown_secs) if cooldown_secs is not None
@@ -138,15 +154,20 @@ class Autoscaler:
             # fresh windows feed the next tick.
             return None
         stats = self._cluster.stats(self.window)
-        current = self._cluster.num_feedable()
+        current = (self._cluster.num_ingest() if self.tier == "ingest"
+                   else self._cluster.num_feedable())
         desired = self.policy.desired(stats, current)
         action, target = self.governor.decide(desired, current,
                                               time.monotonic())
         if action == "hold":
             return None
         snapshot = _snapshot(stats)
+        if self.tier == "ingest":
+            block = stats.get("ingest") or {}
+            snapshot["starved_trainers"] = block.get("starved_trainers")
+            snapshot["cache_hit_rate"] = block.get("cache_hit_rate")
         decision = {"action": action, "current": current,
-                    "desired": desired, "target": target,
+                    "desired": desired, "target": target, "tier": self.tier,
                     "policy": self.policy.name, "stats": snapshot}
         # flight-record EVERY decision with its justification — including
         # cooldown holds, which are where "why didn't it scale?" lives
@@ -162,9 +183,12 @@ class Autoscaler:
         logger.info("autoscaler: %s %d -> %d (desired %d, policy %s, %s)",
                     action, current, target, desired, self.policy.name,
                     snapshot)
-        telemetry.gauge("autoscale.target_nodes").set(target)
+        telemetry.gauge("autoscale.target_nodes" if self.tier == "nodes"
+                        else "autoscale.target_ingest_workers").set(target)
         try:
-            decision["resize"] = self._cluster.resize(
+            resize = (self._cluster.resize_ingest if self.tier == "ingest"
+                      else self._cluster.resize)
+            decision["resize"] = resize(
                 target, drain_timeout=self._drain_timeout)
         except Exception as e:  # noqa: BLE001 - keep the loop alive; next tick retries
             with self._lock:
@@ -185,6 +209,7 @@ class Autoscaler:
             counts = dict(self._counts)
             trail = [dict(d) for d in self._decisions]
         return {"policy": self.policy.describe(),
+                "tier": self.tier,
                 "bounds": [self.governor.min_nodes, self.governor.max_nodes],
                 "tick_secs": self.tick_secs,
                 "cooldown_secs": self.governor.cooldown_secs,

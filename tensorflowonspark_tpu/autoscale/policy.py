@@ -159,6 +159,54 @@ class RowsPerNodeFloorPolicy(Policy):
                 "counter": self.counter}
 
 
+class IngestBacklogPolicy(Policy):
+    """Scale the DATA-SERVICE tier on trainer starvation (the disaggregated
+    ingest tier's satellite policy).
+
+    Reads the ``ingest`` stats block (``cluster.stats()``): any starved
+    trainer — a trainer whose prefetch-queue gauge reads empty — means
+    decode capacity is behind consumption, so add ``step`` worker(s).
+    With nobody starved and the pool's decode throughput per worker under
+    ``min_rows_per_sec`` (decode capacity idling), shrink by one.  The
+    signals are exactly the ``feed.queue_depth``/starvation gauges the
+    node-local feed already exported — the tier reuses them, it does not
+    invent new ones.  Drive it with ``cluster.autoscale(policy=...,
+    tier="ingest")`` so the governor actuates ``cluster.resize_ingest``.
+    """
+
+    name = "ingest_backlog"
+
+    def __init__(self, min_rows_per_sec: float = 1.0, step: int = 1):
+        if min_rows_per_sec <= 0:
+            raise ValueError("need min_rows_per_sec > 0")
+        self.min_rows_per_sec = float(min_rows_per_sec)
+        self.step = max(1, int(step))
+
+    def desired(self, stats: dict, current: int) -> int:
+        block = stats.get("ingest") or {}
+        workers = block.get("workers") or {}
+        if not workers:
+            return current  # no live signal yet: never scale on a vacuum
+        rates = [w.get("forwarded_rows_per_s") or w.get("rows_per_s") or 0.0
+                 for w in workers.values()]
+        # An empty trainer queue alone cannot distinguish "starving behind
+        # decode" from "idle between train() calls" (both read depth 0, and
+        # an idle feed still polls): only starvation WITH the pool actually
+        # decoding is scale-out evidence — an idle cluster instead shrinks
+        # through the under-floor branch below until the governor's min.
+        if (block.get("starved_trainers") or 0) > 0 and any(
+                r > 0.0 for r in rates):
+            return current + self.step
+        if rates and all(r < self.min_rows_per_sec for r in rates):
+            return current - 1
+        return current
+
+    def describe(self) -> dict[str, Any]:
+        return {"name": self.name,
+                "min_rows_per_sec": self.min_rows_per_sec,
+                "step": self.step}
+
+
 class HysteresisGovernor:
     """The anti-flap state machine between a policy and ``cluster.resize``.
 

@@ -432,8 +432,17 @@ class TPUCluster:
         self._train_gen = 0
         self._shutdown_done = False
         # Feedable nodes: everything except the evaluator (the reference also
-        # excluded ps nodes; we have none).
-        self._feed_ids = [m["executor_id"] for m in cluster_info if m["job_name"] != "evaluator"]
+        # excluded ps nodes; we have none) and the data-service tier —
+        # ingest workers are fed the DIRECT ledger's shard items, trainers
+        # are fed rows/paths, and the two lists must never mix.
+        self._feed_ids = [m["executor_id"] for m in cluster_info
+                          if m["job_name"] not in ("evaluator", "ingest")]
+        # Disaggregated ingest tier (ingest/service.py): standalone
+        # data-service nodes (role "ingest") that claim shard items from
+        # the partition ledger and stream decoded chunks to the trainers.
+        # When present, a DIRECT-mode train() feeds THESE slots.
+        self._ingest_ids = [m["executor_id"] for m in cluster_info
+                            if m["job_name"] == "ingest"]
         # Dead-node monitor (SURVEY.md §5.3 — the role Spark played for the
         # reference: the driver NOTICES executor death instead of waiting for
         # a feed/barrier/collective timeout to expire).  A node whose
@@ -528,12 +537,17 @@ class TPUCluster:
         elastic path — a death the supervisor will recover from must not
         leave a fatal node error behind."""
         dead = self.coordinator.dead_nodes(self._dead_after)
-        dead_eval = [i for i in dead if i not in self._feed_ids]
+        dead_eval = [i for i in dead if i not in self._feed_ids
+                     and i not in self._ingest_ids]
         if dead_eval:
             logger.warning("evaluator node(s) %s stopped heartbeating; "
                            "training continues without them", dead_eval)
             self.coordinator.forget(dead_eval)
-        dead_data = [i for i in dead if i in self._feed_ids]
+        # ingest workers are DATA slots for death handling: their ledger
+        # windows requeue and the supervisor recovers them exactly like a
+        # trainer's — the elastic contract of the disaggregated tier
+        dead_data = [i for i in dead
+                     if i in self._feed_ids or i in self._ingest_ids]
         newly: list[int] = []
         # A slot mid-retirement (resize scale-in) dies ON PURPOSE or at
         # worst mid-drain: declare it (fence + rendezvous abort) but never
@@ -911,6 +925,30 @@ class TPUCluster:
             }
             if sync_block is not None:
                 manifest["sync"] = sync_block
+            if self._ingest_ids:
+                # disaggregated tier declaration: map_funs (and operators
+                # reading ctx.job_manifest()) see which tier the ledger
+                # feeds and how the pool is configured — ingest_opts
+                # overrides win over the env knobs, mirroring what the
+                # workers themselves resolve
+                from tensorflowonspark_tpu.ingest.service import (
+                    cache_bytes_default,
+                    shuffle_default,
+                )
+
+                opts = self._ingest_opts()
+                shuffle = opts.get("shuffle")
+                cache_bytes = opts.get("cache_bytes")
+                manifest["ingest"] = {
+                    "workers": len(self._ingest_feedable_ids()),
+                    # None = "not overridden": the env knob applies,
+                    # through the SAME helpers IngestService resolves with
+                    "shuffle": bool(shuffle_default() if shuffle is None
+                                    else shuffle),
+                    "cache_bytes": int(cache_bytes_default()
+                                       if cache_bytes is None
+                                       else cache_bytes),
+                }
             self.coordinator.set_manifest(manifest)
         else:
             if isinstance(data, (str, os.PathLike)):
@@ -1100,7 +1138,19 @@ class TPUCluster:
         # to feed.  A slot mid-drain is excluded from the snapshot for the
         # same reason.
         with self._train_lock:
-            feed_ids = self._feedable_ids()
+            # Disaggregated tier: a DIRECT train over a cluster with ingest
+            # workers feeds THEIR slots — the workers decode and forward,
+            # the trainers consume chunks.  The ledger machinery (and every
+            # elastic property hanging off it) is identical either way;
+            # only the slot membership changes.
+            ingest_tier = (self.input_mode == InputMode.DIRECT
+                           and bool(self._ingest_ids))
+            feed_ids = (self._ingest_feedable_ids() if ingest_tier
+                        else self._feedable_ids())
+            if not feed_ids:
+                raise RuntimeError("no feedable slots for train() (all "
+                                   "retired or draining)")
+            session["tier"] = "ingest" if ingest_tier else "nodes"
             ledger = _PartitionLedger(dataset.num_partitions, num_epochs,
                                       len(feed_ids),
                                       max_attempts=self._max_feed_attempts,
@@ -1379,6 +1429,27 @@ class TPUCluster:
         ``current`` the autoscaler policies compare their desired count to."""
         return len(self._feedable_ids())
 
+    def _ingest_feedable_ids(self) -> list[int]:
+        """Live data-service worker slots (ingest role, not mid-drain) —
+        the ledger targets of a DIRECT train on a disaggregated cluster."""
+        return [eid for eid in self._ingest_ids if eid not in self._retiring]
+
+    def num_ingest(self) -> int:
+        """Live ingest-worker count — the ``current`` an ingest-tier
+        autoscaler policy compares its desired pool size to."""
+        return len(self._ingest_feedable_ids())
+
+    def _ingest_opts(self) -> dict:
+        """The tier's decode configuration as launched
+        (``run(ingest_opts=...)``, carried on every NodeConfig) — the
+        manifest must describe what the workers ACTUALLY run, not the env
+        defaults the opts may override."""
+        for cfg in getattr(self.launcher, "configs", []):
+            opts = getattr(cfg, "ingest_opts", None)
+            if opts:
+                return dict(opts)
+        return {}
+
     def resize(self, num_nodes: int, *, drain_timeout: float | None = None) -> dict:
         """Grow or shrink the LIVE cluster to ``num_nodes`` feedable nodes.
 
@@ -1464,11 +1535,15 @@ class TPUCluster:
             raise RuntimeError("no feedable node config to clone for scale-out")
         return self.launcher.configs[best]
 
-    def _scale_out(self, count: int) -> list[int]:
+    def _spawn_slots(self, count: int, job_name: str, template,
+                     spawn_event: str) -> list[int]:
+        """Shared scale-out spawner (trainer and ingest tiers): open
+        ``count`` slots under ``job_name``, spawn processes cloned from
+        ``template``, and await their registration — rolling membership
+        back on any failure."""
         import dataclasses as _dc
 
-        template = self._worker_template()
-        new_ids = self.coordinator.open_slots(count)
+        new_ids = self.coordinator.open_slots(count, job_name=job_name)
         base = len(self.launcher.processes)
         configs = [_dc.replace(template, launch_index=base + j,
                                replace_executor_id=-1)
@@ -1476,7 +1551,7 @@ class TPUCluster:
         timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
         try:
             self.launcher.spawn_more(configs)
-            ttrace.event("scale_out_spawn", executors=new_ids)
+            ttrace.event(spawn_event, executors=new_ids)
             self.coordinator.await_slots(new_ids, timeout)
         except Exception:
             # reap what never registered: an unjoined newcomer must not
@@ -1501,6 +1576,11 @@ class TPUCluster:
             self.coordinator.cancel_slots(new_ids)
             raise
         self.cluster_info = self.coordinator.cluster_info()
+        return new_ids
+
+    def _scale_out(self, count: int) -> list[int]:
+        new_ids = self._spawn_slots(count, "worker", self._worker_template(),
+                                    "scale_out_spawn")
         for eid in new_ids:
             self._feed_ids.append(eid)
             self._attach_train_slot(eid)
@@ -1509,13 +1589,16 @@ class TPUCluster:
             ttrace.event("scale_out", executor=eid)
         return new_ids
 
-    def _attach_train_slot(self, executor_id: int) -> bool:
+    def _attach_train_slot(self, executor_id: int, tier: str = "nodes") -> bool:
         """Put a scale-out newcomer to work on an in-flight ``train()``:
         add a ledger slot, rebalance queued partitions onto it, and start
-        its feed worker.  No-op (False) when no train is live."""
+        its feed worker.  No-op (False) when no train is live — or when the
+        live train feeds the OTHER tier (a trainer must never be handed the
+        ingest ledger's shard items, nor an ingest worker a row feed)."""
         with self._train_lock:
             session = self._train_session
-            if session is None or executor_id in self._active_ledger:
+            if session is None or executor_id in self._active_ledger \
+                    or session.get("tier", "nodes") != tier:
                 return False
             ledger = session["ledger"]
             pos = ledger.add_slot()
@@ -1686,40 +1769,204 @@ class TPUCluster:
         #    starve a later one into a forced terminate), escalating past
         #    it; then finalize the slot's retirement everywhere.
         for eid in victims:
-            li, proc = self._proc_for(eid)
-            if proc is not None:
-                proc.join(max(2.0, drain_timeout))
-                if proc.is_alive():
-                    logger.warning("retiring node %d did not exit after EOF; "
-                                   "terminating it", eid)
-                    # stop liveness tracking FIRST so the monitor never
-                    # flags the terminate as a death
-                    self.coordinator.forget([eid])
-                    proc.terminate()
-                    proc.join(5.0)
-                    if proc.is_alive():
-                        proc.kill()
-                        proc.join(5.0)
-            # Whatever ended the victim — clean EOF exit, our terminate,
-            # or a kill that landed too close to the reap for the monitor
-            # to declare (retire_node below forecloses that declaration
-            # for good) — put its in-flight + buffered-but-unconsumed
-            # ledger window back in play NOW.  Idempotent: a fully-drained
-            # window requeues nothing, and at-least-once semantics demand
-            # re-feeding anything that cannot be PROVEN consumed.
-            self._requeue_dead_slot(eid)
-            if li >= 0:
-                # a retired node's exit code is not the job's verdict (we
-                # may have terminated it, or chaos killed it mid-drain)
-                self._audit_waived.add(li)
-            self._drop_client(eid, abort=True)
-            self.coordinator.retire_node(eid)
+            self._reap_retired(eid, drain_timeout, "node")
             if self.supervisor is None:
                 telemetry.counter("elastic.retirements_total").inc()
             if eid in self._feed_ids:
                 self._feed_ids.remove(eid)
             self._retiring.discard(eid)
             ttrace.event("scale_in", executor=eid)
+        return victims
+
+    def _reap_retired(self, executor_id: int, drain_timeout: float,
+                      kind: str) -> None:
+        """Shared scale-in reaper tail (trainer and ingest tiers): join the
+        victim past its retirement EOF, escalate to terminate/kill, then
+        finalize — requeue its ledger window, waive its exit code, drop
+        its client, retire the slot.
+
+        The requeue runs whatever ended the victim — clean EOF exit, our
+        terminate, or a kill that landed too close to the reap for the
+        monitor to declare (retire_node forecloses that declaration for
+        good): idempotent (a fully-drained window requeues nothing), and
+        at-least-once semantics demand re-feeding anything that cannot be
+        PROVEN consumed."""
+        li, proc = self._proc_for(executor_id)
+        if proc is not None:
+            proc.join(max(2.0, drain_timeout))
+            if proc.is_alive():
+                logger.warning("retiring %s %d did not exit after EOF; "
+                               "terminating it", kind, executor_id)
+                # stop liveness tracking FIRST so the monitor never flags
+                # the terminate as a death
+                self.coordinator.forget([executor_id])
+                proc.terminate()
+                proc.join(5.0)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(5.0)
+        self._requeue_dead_slot(executor_id)
+        if li >= 0:
+            # a retired node's exit code is not the job's verdict (we may
+            # have terminated it, or chaos killed it mid-drain)
+            self._audit_waived.add(li)
+        self._drop_client(executor_id, abort=True)
+        self.coordinator.retire_node(executor_id)
+
+    # -- data-service tier scaling (the ingest fleet knob) --------------------
+
+    def resize_ingest(self, num_workers: int, *,
+                      drain_timeout: float | None = None) -> dict:
+        """Grow or shrink the data-service tier to ``num_workers`` live
+        ingest workers — the fleet knob BENCH_r12's per-box decode ceiling
+        becomes (decode parallelism was a per-trainer constant before this
+        tier existed).
+
+        Scale-out opens ``ingest``-role slots mid-run, spawns fresh node
+        processes (the coordinator's role assignment routes them into
+        ``ingest.service.ingest_worker_main``), and attaches each to an
+        in-flight ingest-fed ``train()`` with a rebalanced ledger share.
+        Scale-in drains the highest-numbered workers (ledger retire ->
+        orphaned shard items re-feed to surviving workers -> retirement
+        EOF -> reap), with the same at-least-once guarantees a worker
+        death gets.  Trainers are untouched in both directions.
+
+        Limitation: each worker snapshots the TRAINER endpoints at its own
+        boot (``ingest_worker_main`` reads ``ctx.cluster_info``), so a
+        trainer added by ``resize()`` mid-run joins the forwarding
+        rotation only as workers (re)start — resize the trainer fleet
+        between train() calls, or cycle the ingest tier afterwards."""
+        if num_workers < 0:
+            raise ValueError("resize_ingest needs num_workers >= 0")
+        # same preconditions run() enforces for ingest_workers: the tier
+        # only has work on a DIRECT cluster, and a jax_distributed world
+        # has a fixed process count
+        if self.input_mode != InputMode.DIRECT:
+            raise RuntimeError(
+                "resize_ingest needs InputMode.DIRECT: the data-service "
+                "tier claims shard items from the ledger, which a "
+                "STREAMING cluster never produces")
+        if any(getattr(cfg, "jax_distributed", False)
+               for cfg in getattr(self.launcher, "configs", [])):
+            raise RuntimeError(
+                "cannot resize the ingest tier of a jax.distributed job: "
+                "a live XLA world has a fixed process count")
+        with self._resize_lock:
+            if self._closing.is_set() or self._shutdown_done:
+                raise RuntimeError("cluster is shutting down")
+            current = self.num_ingest()
+            t0 = time.monotonic()
+            if num_workers == current:
+                return {"action": "noop", "tier": "ingest",
+                        "from": current, "to": current}
+            if num_workers > current:
+                added = self._scale_out_ingest(num_workers - current)
+                record: dict = {"action": "scale_out", "tier": "ingest",
+                                "from": current, "to": current + len(added),
+                                "added": added}
+            else:
+                retired = self._scale_in_ingest(current - num_workers,
+                                                drain_timeout)
+                record = {"action": "scale_in", "tier": "ingest",
+                          "from": current, "to": current - len(retired),
+                          "retired": retired}
+            record["secs"] = round(time.monotonic() - t0, 3)
+            self._resize_log.append(record)
+            telemetry.counter(f"cluster.ingest_{record['action']}_total").inc()
+            telemetry.gauge("cluster.ingest_workers").set(self.num_ingest())
+            logger.info("ingest tier resized: %s", record)
+            return dict(record)
+
+    def _ingest_template(self):
+        """NodeConfig to clone for ingest scale-out: any live config works
+        (role assignment — not the config — routes a process into the
+        service loop), preferring an existing ingest worker's so its
+        ``ingest_opts`` tuning rides along."""
+        best = None
+        for meta in self.cluster_info:
+            li = meta.get("launch_index", -1)
+            if not 0 <= li < len(self.launcher.configs):
+                continue
+            if meta["executor_id"] in self._ingest_ids:
+                return self.launcher.configs[li]
+            if best is None:
+                best = self.launcher.configs[li]
+        if best is None:
+            raise RuntimeError("no node config to clone for ingest scale-out")
+        return best
+
+    def _scale_out_ingest(self, count: int) -> list[int]:
+        new_ids = self._spawn_slots(count, "ingest", self._ingest_template(),
+                                    "ingest_scale_out_spawn")
+        for eid in new_ids:
+            self._ingest_ids.append(eid)
+            self._attach_train_slot(eid, tier="ingest")
+            ttrace.event("ingest_scale_out", executor=eid)
+        return new_ids
+
+    def _scale_in_ingest(self, count: int,
+                         drain_timeout: float | None) -> list[int]:
+        if drain_timeout is None:
+            drain_timeout = _env_float("TOS_DRAIN_TIMEOUT", 60.0)
+        candidates = [eid for eid in self._ingest_ids
+                      if eid not in self._retiring]
+        if len(candidates) < count:
+            raise ValueError(f"cannot retire {count} ingest worker(s): only "
+                             f"{len(candidates)} live")
+        victims = sorted(candidates)[-count:]  # newest workers first out
+        with self._train_lock:
+            # A live ingest-fed train() must keep at least one worker: the
+            # trainer tier's analogue is the chief-never-retires floor —
+            # with ZERO survivors every ledger slot would retire, queued
+            # partitions would orphan with nobody to deliver them, and
+            # train() would return "success" with records never decoded.
+            if (self._train_session is not None
+                    and self._train_session.get("tier") == "ingest"
+                    and count >= len(candidates)):
+                raise RuntimeError(
+                    "cannot retire every ingest worker while an ingest-fed "
+                    "train() is in flight: its ledger partitions would "
+                    "orphan with no worker to deliver them; keep >= 1, or "
+                    "retry after the train completes")
+            for eid in victims:
+                self._retiring.add(eid)
+        for eid in victims:
+            if self.supervisor is not None:
+                self.supervisor.retire(eid)
+        self.coordinator.mark_draining(victims)
+        ttrace.event("ingest_drain_begin", executors=victims)
+        # queued shard items to the orphan pool; surviving workers (or the
+        # victims themselves, for their in-flight item) deliver them
+        with self._train_lock:
+            entries = [(eid, self._active_ledger.get(eid)) for eid in victims]
+        for eid, entry in entries:
+            if entry is not None:
+                moved = entry[0].retire_slot(entry[1])
+                if moved:
+                    logger.info("%d queued shard item(s) of retiring ingest "
+                                "worker %d redistributed", moved, eid)
+        for eid, entry in entries:
+            if entry is None:
+                continue
+            ledger, pos = entry
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                if ledger.slot_idle(pos) and not ledger.needs_drain(pos):
+                    break
+                if not self.coordinator.is_tracked(eid):
+                    break
+                if self._closing.is_set():
+                    break
+                time.sleep(0.1)
+        for eid in victims:
+            if self.coordinator.is_tracked(eid):
+                self._send_retirement_eof(eid)
+        for eid in victims:
+            self._reap_retired(eid, drain_timeout, "ingest worker")
+            if eid in self._ingest_ids:
+                self._ingest_ids.remove(eid)
+            self._retiring.discard(eid)
+            ttrace.event("ingest_scale_in", executor=eid)
         return victims
 
     def autoscale(self, policy=None, **kwargs):
@@ -1806,17 +2053,33 @@ class TPUCluster:
                 for m in self.cluster_info
                 if 0 <= m.get("launch_index", -1) < len(procs)
             }
-            for executor_id in self._feed_ids:
+            # Ingest workers FIRST: their EOF ends the shard feed, each
+            # service forwards its pipeline tail and exits — and the brief
+            # join below lets that tail land BEFORE any trainer's
+            # EndOfFeed is queued (FIFO: a chunk delivered before the
+            # trainer's EOF is consumed, one after it is teardown-dropped).
+            def _eof_node(executor_id: int) -> None:
                 proc = id_to_proc.get(executor_id)
                 if proc is not None and not proc.is_alive():
                     # node already finished and tore down its data plane;
                     # an EOF would only block on a dead peer
                     logger.debug("node %d already exited; skipping EOF",
                                  executor_id)
-                    continue
+                    return
                 for qname in self.input_qnames:
-                    self._send_eof_best_effort(
-                        executor_id, qname, proc=id_to_proc.get(executor_id))
+                    self._send_eof_best_effort(executor_id, qname, proc=proc)
+
+            for executor_id in self._ingest_ids:
+                _eof_node(executor_id)
+            if self._ingest_ids:
+                tail_deadline = time.monotonic() + min(15.0, timeout / 4.0)
+                while time.monotonic() < tail_deadline and any(
+                        p is not None and p.is_alive()
+                        for p in (id_to_proc.get(e)
+                                  for e in self._ingest_ids)):
+                    time.sleep(0.1)
+            for executor_id in self._feed_ids:
+                _eof_node(executor_id)
             if grace_secs:
                 time.sleep(grace_secs)
             # Politely wait for map_funs to finish; only then escalate.  The
@@ -2148,6 +2411,8 @@ def run(
     jax_distributed: bool = False,
     coordinator_host: str | None = None,
     elastic: bool | RestartPolicy = False,
+    ingest_workers: int | None = None,
+    ingest_opts: dict | None = None,
 ) -> TPUCluster:
     """Start a cluster (reference ``TFCluster.run`` ``:~270-420``).
 
@@ -2176,6 +2441,19 @@ def run(
     and map_funs built on control-plane consensus (``ctx.all_done``) need
     application-level resync a restart does not provide.
 
+    ``ingest_workers`` (default ``TOS_INGEST_WORKERS``) adds that many
+    standalone DATA-SERVICE nodes (role ``ingest``, the tf.data-service
+    design): a DIRECT-mode ``train()`` then feeds its shard items to the
+    worker pool, which decodes on its own cores (with the cross-epoch
+    chunk cache, ``TOS_INGEST_CACHE_BYTES``) and streams packed chunks to
+    every trainer over the zero-copy wire — decode parallelism becomes the
+    ``cluster.resize_ingest`` fleet knob instead of a per-trainer
+    constant.  ``ingest_opts`` carries the tier's decode configuration
+    (``schema=``, ``chunk_records=``, ``readers=``, ``cache_bytes=``,
+    ``shuffle=``, ... — :class:`~tensorflowonspark_tpu.ingest.service.
+    IngestService` keywords).  DIRECT mode only, and not combinable with
+    ``jax_distributed`` (the workers are not XLA-world members).
+
     ``coordinator_host`` pins the control-plane bind/advertise interface
     (default: bind all interfaces, advertise the routable ``local_ip()`` so
     remote executors launched over ssh can actually dial back — reference
@@ -2197,9 +2475,31 @@ def run(
         reservation_timeout = _env_float("TOS_RESERVATION_TIMEOUT", 120.0)
     if feed_timeout is None:
         feed_timeout = _env_float("TOS_FEED_TIMEOUT", 600.0)
-    if per_node_env is not None and len(per_node_env) != num_executors:
-        raise ValueError(f"per_node_env needs {num_executors} entries, got {len(per_node_env)}")
+    if ingest_workers is None:
+        ingest_workers = _env_int("TOS_INGEST_WORKERS", 0, minimum=0)
+    ingest_workers = max(0, int(ingest_workers))
+    if ingest_workers and input_mode != InputMode.DIRECT:
+        raise ValueError(
+            "ingest_workers need InputMode.DIRECT: the data-service tier "
+            "claims shard items from the ledger (STREAMING clusters stream "
+            "rows from the driver and have nothing for the tier to decode)")
+    if ingest_workers and jax_distributed:
+        raise ValueError(
+            "ingest_workers cannot be combined with jax_distributed: "
+            "data-service workers are not members of the XLA world and "
+            "jax.distributed.initialize counts contiguous process ids")
+    total_procs = num_executors + ingest_workers
+    if per_node_env is not None and len(per_node_env) not in (
+            num_executors, total_procs):
+        raise ValueError(f"per_node_env needs {num_executors} (trainer) or "
+                         f"{total_procs} (trainer+ingest) entries, got "
+                         f"{len(per_node_env)}")
     roles = _build_roles(num_executors, master_node, eval_node)
+    # data-service slots come LAST so trainer/evaluator ids keep their
+    # contiguous reference layout; role assignment is registration-order,
+    # so node_main's role-aware dispatch (not the config) decides which
+    # process actually runs the service loop
+    roles.extend(("ingest", i) for i in range(ingest_workers))
     authkey = secrets.token_bytes(16)
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
@@ -2209,7 +2509,7 @@ def run(
     # (TPUCluster wires the CoordinatorSupervisor); journal-less
     # coordinators keep the old behaviour — a crash is fatal.
     coordinator = CoordinatorServer(
-        num_executors, roles, authkey=authkey,
+        total_procs, roles, authkey=authkey,
         journal_path=(os.path.join(log_dir, "coordinator.journal")
                       if log_dir else None))
     addr = coordinator.start(coordinator_host)
@@ -2232,10 +2532,13 @@ def run(
             log_dir=log_dir,
             tensorboard=tensorboard,
             jax_distributed=jax_distributed,
-            env={**(env or {}), **(per_node_env[i] if per_node_env else {})},
+            env={**(env or {}),
+                 **(per_node_env[i] if per_node_env is not None
+                    and i < len(per_node_env) else {})},
             launch_index=i,
+            ingest_opts=dict(ingest_opts) if ingest_opts else None,
         )
-        for i in range(num_executors)
+        for i in range(total_procs)
     ]
     # Default to SubprocessLauncher: children run the lean ``node_entry``
     # module directly (~0.5s to a live node), where multiprocessing-spawn

@@ -817,6 +817,22 @@ class CoordinatorServer:
             return (self._incarnations.get(executor_id, 0),
                     executor_id in self._last_seen)
 
+    def role_of(self, executor_id: int) -> str | None:
+        """The slot's assigned role name ('chief'/'worker'/'evaluator'/
+        'ingest'/...), or None for an unknown id — the role-aware half of
+        the slot registry (executor ids index the role table by
+        construction: ids are assigned in registration order)."""
+        with self._lock:
+            if 0 <= executor_id < len(self.roles):
+                return self.roles[executor_id][0]
+            return None
+
+    def role_ids(self, job_name: str) -> list[int]:
+        """Executor ids whose slot carries the named role."""
+        with self._lock:
+            return [i for i, (name, _t) in enumerate(self.roles)
+                    if name == job_name]
+
     # -- elastic membership (cluster.resize) ---------------------------------
 
     def open_slots(self, count: int, job_name: str = "worker") -> list[int]:
@@ -1103,7 +1119,63 @@ class CoordinatorServer:
                 key: (s.get("gauges") or {}).get("feed.queue_depth")
                 for key, s in out["streams"].items() if key != "driver"},
         }
+        ingest_ids = self.role_ids("ingest")
+        if ingest_ids:
+            out["ingest"] = self._ingest_stats_block(out["streams"],
+                                                     ingest_ids)
         return out
+
+    def _ingest_stats_block(self, streams: dict, ingest_ids: list[int]) -> dict:
+        """The data-service tier's headline stats: per-worker decode MB/s
+        and cache hit rate, plus the starved-trainer gauge — ONE surface
+        the ingest autoscale policy and operators both read (satellite of
+        the disaggregated-ingest tier)."""
+        workers: dict[str, dict] = {}
+        hits = misses = 0.0
+        for eid in ingest_ids:
+            s = streams.get(str(eid))
+            if s is None:
+                continue
+            rates = s.get("rates") or {}
+            gauges = s.get("gauges") or {}
+            h = rates.get("ingest.cache_hits") or 0.0
+            m = rates.get("ingest.cache_misses") or 0.0
+            hits += h
+            misses += m
+            workers[str(eid)] = {
+                "decode_mb_per_s": round(
+                    (rates.get("ingest.bytes_read") or 0.0) / 1e6, 3),
+                "rows_per_s": rates.get("ingest.records_read"),
+                "forwarded_rows_per_s": rates.get("ingest.rows_forwarded"),
+                "cache_hit_rate": (round(h / (h + m), 4)
+                                   if (h + m) > 0 else None),
+                "cache_bytes": gauges.get("ingest.cache_bytes"),
+            }
+        ingest_set = set(ingest_ids)
+        trainer_keys = [key for key in streams
+                        if key != "driver" and key.isdigit()
+                        and int(key) not in ingest_set
+                        and self.role_of(int(key)) != "evaluator"]
+        starved = sum(
+            1 for key in trainer_keys
+            if ((streams[key].get("gauges") or {}).get("feed.queue_depth")
+                == 0))
+        return {
+            "workers": workers,
+            "cache_hit_rate": (round(hits / (hits + misses), 4)
+                               if (hits + misses) > 0 else None),
+            # trainers whose prefetch queue gauge reads EMPTY right now —
+            # the tier-is-undersized signal the autoscale policy scales on
+            "starved_trainers": starved,
+            # windowed rate of empty feed polls across the trainer fleet
+            # (feed.starved_polls — the counter form of the same signal)
+            "trainer_starved_polls_per_s": round(sum(
+                (streams[key].get("rates") or {}).get("feed.starved_polls")
+                or 0.0 for key in trainer_keys), 3),
+            "trainers_reporting": len(trainer_keys),
+            "draining_workers": sorted(
+                eid for eid in self.draining_nodes() if eid in ingest_set),
+        }
 
     def cluster_metrics(self) -> dict:
         """Aggregated cluster snapshot (the ``metrics`` op / the

@@ -382,6 +382,78 @@ class PackedChunk:
         raise ValueError(f"corrupt PackedChunk layout {self.layout!r}")
 
 
+class DecodedChunk:
+    """One pre-decoded ingest chunk in flight from a data-service worker to
+    a trainer (the ``chunk_fwd`` wire op).
+
+    ``payload`` is exactly what a trainer-local reader pipeline would have
+    pushed: a list of record payloads (owned ``bytes`` — never zero-copy
+    views, which cannot travel a wire), or a ``dfutil.ColumnChunk`` whose
+    contiguous column buffers ride the v2/v3 wire out-of-band.  The
+    trainer-side ``IngestFeed`` recognizes the wrapper on its input queue
+    and injects the payload straight into its pipeline's decoded-chunk
+    queue — the feed becomes a pure consumer, with the partition watermark
+    accounting unchanged (each forwarded chunk is one "shard" of its
+    ledger partition).  ``source`` is an opaque provenance tag (the
+    worker's work-item key) for telemetry and debugging only.
+    """
+
+    __slots__ = ("payload", "nrows", "source", "_nbytes")
+
+    def __init__(self, payload, source=None):
+        self.payload = payload
+        self.nrows = len(payload)
+        self.source = source
+        self._nbytes: int | None = None
+
+    @property
+    def nbytes(self) -> int:
+        """Payload bytes, computed once per wrapper (the forwarder's
+        byte counters must not re-walk every record per delivery)."""
+        if self._nbytes is None:
+            self._nbytes = chunk_nbytes(self.payload)
+        return self._nbytes
+
+    def __reduce__(self):
+        return (DecodedChunk, (self.payload, self.source))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<DecodedChunk rows={self.nrows} source={self.source!r}>"
+
+
+def chunk_nbytes(payload) -> int:
+    """Approximate payload bytes of one decoded chunk (record list or
+    ``dfutil.ColumnChunk``) — the accounting unit of the ingest tier's
+    cross-epoch chunk cache (``TOS_INGEST_CACHE_BYTES``) and its forwarded-
+    bytes counters.  Cheap and slightly conservative: python object
+    overhead is not charged, only payload bytes."""
+    import numpy as np
+
+    if hasattr(payload, "columns") and hasattr(payload, "counts"):
+        total = 0
+        for col in payload.columns.values():
+            if isinstance(col, np.ndarray):
+                total += col.nbytes
+            else:  # bytes/str column: a plain list of per-record values
+                total += sum(len(v) for v in col)
+        for counts in payload.counts.values():
+            total += (counts.nbytes if isinstance(counts, np.ndarray)
+                      else 8 * len(counts))
+        return total
+    total = 0
+    for r in payload:
+        if isinstance(r, (bytes, bytearray, memoryview)):
+            total += len(r)
+        elif isinstance(r, np.ndarray):
+            total += r.nbytes
+        elif isinstance(r, tuple):
+            total += sum(len(v) if isinstance(v, (bytes, memoryview))
+                         else getattr(v, "nbytes", 8) for v in r)
+        else:
+            total += getattr(r, "nbytes", 64)
+    return total
+
+
 def pack_chunk(items: list) -> PackedChunk | None:
     """Columnar-pack a homogeneous chunk, or None when it does not qualify
     (the caller then sends the plain list — semantics are identical either

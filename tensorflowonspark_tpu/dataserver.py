@@ -388,12 +388,31 @@ class DataServer:
             # wire-format negotiation: a client that gets an unknown-op error
             # back (old server) stays on v1; see WIRE_VERSION
             return ("ok", min(WIRE_VERSION, int(msg[1])))
-        if op in ("feed", "infer_send", "infer_round"):
+        if op in ("feed", "infer_send", "infer_round", "chunk_fwd"):
             # chaos seams: `delay_net:ms=M` injects wire latency on every
             # data-carrying op; `sever`/`flap` may raise FaultInjected so
-            # the connection closes with no reply
+            # the connection closes with no reply (chunk_fwd is the
+            # trainer<->ingest-worker stream — severable like the rest)
             faultinject.net_delay()
             faultinject.data_op()
+        if op == "chunk_fwd":
+            # Disaggregated ingest tier: a data-service worker forwards
+            # PRE-DECODED chunks (data.DecodedChunk wrappers) into this
+            # trainer's input queue; the trainer's IngestFeed injects the
+            # payloads into its pipeline as a pure consumer.  Same
+            # backpressure/terminating contract as `feed`.
+            _, qname, chunks = msg
+            telemetry.counter("dataplane.chunks_in").inc(len(chunks))
+            telemetry.counter("dataplane.rows_in").inc(
+                sum(c.nrows for c in chunks))
+            if self.queues.get("state") == "terminating":
+                return ("ok", "terminating")
+            q = self.queues.get_queue(qname)
+            for c in chunks:
+                state = self._put_responsive(q, c)
+                if state is not None:
+                    return state
+            return ("ok", "running")
         if op == "feed":
             _, qname, items = msg
             items = _unpack_items(items)
@@ -958,6 +977,16 @@ class DataClient:
         telemetry.counter("dataplane.chunks_sent").inc(chunks_sent)
         telemetry.counter("dataplane.rows_sent").inc(rows_sent)
         return state
+
+    def forward_chunks(self, chunks: list, qname: str = "input") -> str:
+        """Push pre-decoded ``data.DecodedChunk`` items into the node's
+        input queue (the ingest-worker -> trainer hot path); returns the
+        node state ('running'/'terminating').  One bounded round-trip per
+        call — the reply IS the delivery ack the worker's consumption
+        watermark advances on, so a chunk is never reported consumed
+        before a trainer has actually buffered it."""
+        reply = self._call(("chunk_fwd", qname, list(chunks)))
+        return reply[1] if len(reply) > 1 else "running"
 
     def partitions_consumed(self, qname: str = "input") -> int | None:
         """The node's cumulative fully-consumed-partition count as of the

@@ -49,7 +49,10 @@ Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
 
 Common keys: ``executor=E`` fires only on that executor id (ids are assigned
 at registration, so per-node targeting usually rides ``per_node_env``
-instead); ``incarnation=I`` fires only at that node incarnation — the idiom
+instead); ``role=R`` fires only in processes whose ASSIGNED cluster role
+matches (``role=ingest`` targets the data-service tier from a cluster-wide
+spec — roles are registration-order, so per-launch-index env cannot);
+``incarnation=I`` fires only at that node incarnation — the idiom
 for "die once": a restarted node re-parses the same env but its incarnation
 moved on, so the fault stays disarmed.  Counters are plain in-process
 counts — same schedule every run.
@@ -74,15 +77,17 @@ class FaultInjected(Exception):
 
 
 class _Action:
-    __slots__ = ("name", "threshold", "executor", "incarnation", "fired",
-                 "count", "hb_cycle", "sever_cycle")
+    __slots__ = ("name", "threshold", "executor", "incarnation", "role",
+                 "fired", "count", "hb_cycle", "sever_cycle")
 
     def __init__(self, name: str, threshold: int,
-                 executor: int | None, incarnation: int | None):
+                 executor: int | None, incarnation: int | None,
+                 role: str | None = None):
         self.name = name
         self.threshold = threshold
         self.executor = executor
         self.incarnation = incarnation
+        self.role = role
         self.fired = False
         self.count = 0
         # flap bookkeeping: last down-window index counted / severed, so
@@ -124,6 +129,7 @@ class FaultPlan:
         self._actions = actions
         self._executor_id: int | None = None
         self._incarnation = 0
+        self._role = ""
         self._t0 = time.monotonic()  # flap phase anchor (arming time)
 
     @classmethod
@@ -138,21 +144,33 @@ class FaultPlan:
             if name not in cls._KEYS:
                 raise ValueError(f"unknown fault action {name!r} in {spec!r}")
             kv = {}
+            role: str | None = None
             for pair in filter(None, (p.strip() for p in rest.split(","))):
                 k, _, v = pair.partition("=")
-                kv[k.strip()] = int(v)
+                k = k.strip()
+                if k == "role":
+                    # role filter (string-valued): fire only in processes
+                    # whose ASSIGNED cluster role matches — the idiom for
+                    # targeting the data-service tier, whose role is
+                    # registration-order and so cannot ride per_node_env
+                    role = v.strip()
+                    continue
+                kv[k] = int(v)
             threshold = kv.pop(cls._KEYS[name], 1)
             executor = kv.pop("executor", None)
             incarnation = kv.pop("incarnation", None)
             if kv:
                 raise ValueError(f"unknown keys {sorted(kv)} for fault {name!r}")
-            actions.append(_Action(name, threshold, executor, incarnation))
+            actions.append(_Action(name, threshold, executor, incarnation,
+                                   role))
         return cls(actions)
 
-    def set_identity(self, executor_id: int, incarnation: int = 0) -> None:
+    def set_identity(self, executor_id: int, incarnation: int = 0,
+                     role: str = "") -> None:
         with self._lock:
             self._executor_id = executor_id
             self._incarnation = incarnation
+            self._role = role
 
     def _tick(self, name: str) -> bool:
         """Advance the named action's counter; True when it fires this call."""
@@ -163,6 +181,8 @@ class FaultPlan:
                 if a.executor is not None and a.executor != self._executor_id:
                     continue
                 if a.incarnation is not None and a.incarnation != self._incarnation:
+                    continue
+                if a.role is not None and a.role != self._role:
                     continue
                 a.count += 1
                 if a.name in self._WINDOWED:
@@ -185,6 +205,8 @@ class FaultPlan:
                 if a.executor is not None and a.executor != self._executor_id:
                     continue
                 if a.incarnation is not None and a.incarnation != self._incarnation:
+                    continue
+                if a.role is not None and a.role != self._role:
                     continue
                 return a
         return None
@@ -289,9 +311,10 @@ def init_from_env(force: bool = False) -> None:
     logger.warning("fault injection armed: %s=%r", ENV_VAR, spec)
 
 
-def set_identity(executor_id: int, incarnation: int = 0) -> None:
+def set_identity(executor_id: int, incarnation: int = 0,
+                 role: str = "") -> None:
     if _PLAN is not None:
-        _PLAN.set_identity(executor_id, incarnation)
+        _PLAN.set_identity(executor_id, incarnation, role=role)
 
 
 def _sigkill_self() -> None:

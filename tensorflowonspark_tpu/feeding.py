@@ -233,6 +233,12 @@ class DataFeed:
                     try:
                         item = q.get(timeout=self.poll_interval)
                     except queue.Empty:
+                        # starvation signal: the consumer wanted data and
+                        # the feed had none for a whole poll interval —
+                        # the rate of this counter (vs feed.batches) is
+                        # the "trainers starve while decode lags" evidence
+                        # the ingest-tier autoscaling reads
+                        telemetry.counter("feed.starved_polls").inc()
                         continue
             if isinstance(item, EndPartition):
                 # the marker is FIFO-last for its partition: popping it means

@@ -19,12 +19,22 @@ Pieces:
 - :mod:`~tensorflowonspark_tpu.ingest.feed` — :class:`IngestFeed`, the
   DIRECT-mode ``DataFeed`` twin a map_fun gets from ``ctx.get_data_feed()``.
 
+- :mod:`~tensorflowonspark_tpu.ingest.service` — the DISAGGREGATED tier:
+  standalone data-service workers (``role="ingest"``,
+  ``cluster.run(ingest_workers=N)``) that claim the ledger's shard items,
+  decode on their own cores with a cross-epoch :class:`ChunkCache`, and
+  stream packed chunks to trainers over the zero-copy wire — the trainers'
+  :class:`IngestFeed` then acts as a pure consumer.
+
 Knobs: ``TOS_INGEST_READERS`` (reader-pool ceiling), ``TOS_INGEST_PREFETCH``
 (decoded-chunk prefetch depth), ``TOS_INGEST_AUTOTUNE`` (occupancy-driven
 pool sizing), ``TOS_INGEST_ZEROCOPY`` (memoryview record views — 0 restores
 bytes copies, ``debug`` makes retained views fail loudly),
 ``TOS_INGEST_SPAN_BYTES`` (sub-shard split granularity; 0 keeps shards
-whole).
+whole), ``TOS_INGEST_WORKERS`` (data-service tier size),
+``TOS_INGEST_CACHE_BYTES`` (cross-epoch chunk-cache budget; 0 disables),
+``TOS_INGEST_SHUFFLE`` (global shuffle across the pool; 0 pins workers to
+trainers).
 """
 
 from tensorflowonspark_tpu.ingest.feed import IngestFeed  # noqa: F401
@@ -35,9 +45,16 @@ from tensorflowonspark_tpu.ingest.readers import (  # noqa: F401
     device_prefetch,
     prefetch_iterator,
 )
+from tensorflowonspark_tpu.ingest.service import (  # noqa: F401
+    ChunkCache,
+    IngestService,
+    TrainerForwarder,
+    ingest_worker_main,
+)
 from tensorflowonspark_tpu.ingest.shards import (  # noqa: F401
     ShardSpan,
     enumerate_shards,
     shards_as_partitioned,
     split_shards,
+    work_item_key,
 )

@@ -40,6 +40,7 @@ from time import monotonic as _monotonic
 from time import sleep as _sleep
 
 from tensorflowonspark_tpu import faultinject, telemetry
+from tensorflowonspark_tpu.data import DecodedChunk
 from tensorflowonspark_tpu.feeding import FeedQueues, batch_to_columns
 from tensorflowonspark_tpu.ingest.readers import ReaderPipeline, ShardDone
 from tensorflowonspark_tpu.ingest.shards import ShardSpan
@@ -117,6 +118,7 @@ class IngestFeed:
         zerocopy=None,
         schema=None,
         binary_features=None,
+        cache=None,
     ):
         self.queues = queues
         self.train_mode = train_mode
@@ -137,7 +139,7 @@ class IngestFeed:
             readers=readers, autotune=autotune, prefetch=prefetch,
             chunk_records=chunk_records, decode=decode, verify=verify,
             stop_event=self._abandon, zerocopy=zerocopy, schema=schema,
-            binary_features=binary_features)
+            binary_features=binary_features, cache=cache)
         # debug zero-copy: views handed out in the LAST returned batch;
         # released (-> late access raises ValueError) when that batch
         # retires at the next next_batch call
@@ -191,6 +193,20 @@ class IngestFeed:
                 if isinstance(item, EndOfFeed):
                     return
                 if isinstance(item, Marker):
+                    continue
+                if isinstance(item, DecodedChunk):
+                    # Disaggregated ingest tier: a data-service worker
+                    # already decoded this chunk — inject it straight into
+                    # the pipeline's decoded-chunk queue (this feed is a
+                    # pure consumer).  Each forwarded chunk counts as one
+                    # "shard" of its ledger partition, so the watermark
+                    # machinery below is byte-for-byte the node-local one.
+                    if open_job is None:
+                        open_job = _PartitionJob()
+                    with self._jobs_lock:
+                        open_job.n_shards += 1
+                    self.pipeline.inject(item.payload, open_job,
+                                         source=item.source)
                     continue
                 if not isinstance(item, (str, ShardSpan)):
                     raise TypeError(
@@ -333,6 +349,9 @@ class IngestFeed:
             try:
                 item = self.pipeline.get(timeout=self.poll_interval)
             except queue.Empty:
+                # same starvation counter as the streaming DataFeed: an
+                # empty poll with the consumer hungry (decode behind)
+                telemetry.counter("feed.starved_polls").inc()
                 continue
             if item is None:  # pipeline fully drained (EndOfFeed reached)
                 self._drained = True
@@ -384,6 +403,59 @@ class IngestFeed:
             return {tname: out[cname]
                     for cname, tname in self.input_mapping.items()}
         return out
+
+    def next_chunk(self):
+        """Pop the next WHOLE decoded chunk (a record list, or a
+        ``dfutil.ColumnChunk`` in schema mode), or ``None`` at end of feed.
+
+        The data-service worker's consumption surface (``ingest/service.py``):
+        a forwarder wants pipeline-sized units to ship, not re-batched
+        records.  Same watermark contract as ``next_batch`` — calling again
+        is the proof the previous chunk was fully handed over (for the
+        service: forwarded AND acked by a trainer), so the partition-
+        consumed report the driver's ledger drains on only ever lags the
+        actual delivery.  Mixing ``next_chunk`` and ``next_batch`` on one
+        feed is not supported (the batch carry-over state is not shared)."""
+        while self.queues.get("state") == "parked":
+            if self.stop_event is not None and self.stop_event.is_set():
+                break
+            _sleep(self.poll_interval)
+        self._report_ready_keys()  # the previous chunk has been handed over
+        while True:
+            if self._claim_error is not None:
+                raise RuntimeError(
+                    f"ingest claim loop failed: {self._claim_error}"
+                ) from self._claim_error
+            if self._drained:
+                self.done_feeding = True
+                return None
+            if self.stop_event is not None and self.stop_event.is_set():
+                self.pipeline.stop()
+                self.done_feeding = True
+                return None
+            self._report_ready_keys()
+            try:
+                item = self.pipeline.get(timeout=self.poll_interval)
+            except queue.Empty:
+                telemetry.counter("feed.starved_polls").inc()
+                continue
+            if item is None:
+                self._drained = True
+                continue
+            if isinstance(item, ShardDone):
+                # nothing undelivered in hand by construction (whole chunks
+                # only): a closed partition is safe to report immediately
+                self._on_shard_done(item, batch_empty=True)
+                continue
+            self._occupancy.set(self.pipeline.depth())
+            # service-side counters, DISTINCT from the trainer feed's
+            # feed.rows_consumed: the worker claims these rows and the
+            # trainer consumes the very same ones — double-counting one
+            # name would double the run report's cluster aggregate
+            telemetry.counter("ingest.chunks_claimed").inc()
+            telemetry.counter("ingest.rows_claimed").inc(len(item))
+            faultinject.batch_consumed()
+            return item
 
     # -- producing results ---------------------------------------------------
 

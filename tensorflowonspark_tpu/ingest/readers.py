@@ -87,6 +87,27 @@ def zerocopy_mode(zerocopy=None) -> str:
     return "on"
 
 
+def _materialize_chunk(chunk):
+    """An OWNED copy of one decoded chunk, safe to outlive its shard read:
+    ``memoryview`` records become ``bytes``; a ``dfutil.ColumnChunk`` whose
+    column arrays view the shard mmap is rebuilt over owning arrays.
+    Already-owned chunks (bytes records, owning arrays) copy the list
+    head only."""
+    import numpy as np
+
+    if hasattr(chunk, "columns") and hasattr(chunk, "counts"):
+        cols = {name: (np.array(col, copy=True)
+                       if isinstance(col, np.ndarray)
+                       and not col.flags.owndata else col)
+                for name, col in chunk.columns.items()}
+        if all(cols[n] is chunk.columns[n] for n in cols):
+            return chunk  # every column already owns its buffer
+        clone = type(chunk)(cols, chunk.counts, chunk.n, chunk.scalars,
+                            chunk.widths)
+        return clone
+    return [bytes(r) if type(r) is memoryview else r for r in chunk]
+
+
 class ShardDone:
     """Control token: every record of one claimed shard has been pushed
     (FIFO) before this token — popping it proves the shard fully drained
@@ -122,7 +143,8 @@ class ReaderPipeline:
                  autotune: bool | None = None, prefetch: int | None = None,
                  chunk_records: int = 256, decode=None, verify: bool = True,
                  stop_event: threading.Event | None = None,
-                 zerocopy=None, schema=None, binary_features=None):
+                 zerocopy=None, schema=None, binary_features=None,
+                 cache=None):
         self._max_readers = max(0, readers if readers is not None
                                 else _env_int("TOS_INGEST_READERS", 4, minimum=0))
         # Zero-copy decode contract (TOS_INGEST_ZEROCOPY, default ON): plain
@@ -159,6 +181,16 @@ class ReaderPipeline:
         self.chunk_records = max(1, chunk_records)
         self.decode = decode
         self.verify = verify
+        # Cross-epoch chunk cache (ingest/service.py ChunkCache, or any
+        # object with get/put/key_for): repeated reads of the same work
+        # item + schema serve MATERIALIZED decoded chunks from memory
+        # instead of re-running the CRC scan + decode.  Inactive with a
+        # per-record ``decode`` callable — its identity cannot be part of
+        # the cache key, and serving another decoder's output would be
+        # silent corruption.
+        self._cache = cache if (cache is not None
+                                and getattr(cache, "enabled", True)
+                                and decode is None) else None
         # sync mode buffers one whole shard's chunks at a time (get() is
         # both reader and consumer, so a bounded put would self-deadlock)
         self._out: queue.Queue = queue.Queue(maxsize=0 if self._sync else depth)
@@ -183,6 +215,24 @@ class ReaderPipeline:
         sub-shard range — for a reader to claim; ``tag`` rides the item's
         ``ShardDone`` token back to the consumer."""
         self._work.put((path, tag))
+
+    def inject(self, payload, tag=None, source=None) -> bool:
+        """Producer-side: hand an ALREADY-DECODED chunk (a record list or a
+        ``dfutil.ColumnChunk``) straight to the consumer, bypassing the
+        readers — how the trainer-side feed consumes chunks a data-service
+        worker decoded remotely (``data.DecodedChunk``).  FIFO with the
+        work-item bookkeeping: the chunk's ``ShardDone`` follows it
+        immediately, so the partition watermark machinery sees each
+        forwarded chunk as one fully-drained "shard".  Returns False when
+        the pipeline was stopped with the consumer gone."""
+        if not self._put(payload):
+            return False
+        ok = self._put(ShardDone(source if source is not None
+                                 else "<forwarded>", tag))
+        if ok:
+            telemetry.counter("ingest.chunks_injected").inc()
+            telemetry.counter("ingest.records_injected").inc(len(payload))
+        return ok
 
     def close(self) -> None:
         """No more shards will be submitted; readers exit as the work queue
@@ -249,14 +299,31 @@ class ReaderPipeline:
             try:
                 path, tag = self._work.get_nowait()
             except queue.Empty:
-                return None
+                # observing closed proves every inject() already landed
+                # (the claimer injects before calling close, and both
+                # sides synchronize on self._lock) — so ONE out-queue
+                # re-check closes the race where a chunk was injected
+                # between the get_nowait at the top and the closed read
+                # above; without it that chunk would be stranded and the
+                # feed would report drained with records undelivered
+                try:
+                    item = self._out.get_nowait()
+                except queue.Empty:
+                    return None
+                return None if item is _DRAINED else item
         else:
             try:
                 path, tag = self._work.get(timeout=timeout)
             except queue.Empty:
                 with self._lock:
-                    if self._closed:
-                        return None
+                    closed = self._closed
+                if closed:
+                    # closed while we were blocked on the (empty) work
+                    # queue — but chunks may have been inject()ed into the
+                    # out queue during that wait (the pure-consumer feed's
+                    # claimer): re-enter from the top, which drains them
+                    # before the work-empty check can answer drained
+                    return self._sync_get(timeout)
                 raise
         try:
             with telemetry.timed("ingest.shard_read_secs"):
@@ -366,6 +433,39 @@ class ReaderPipeline:
         chunks of spans decode columnar (``dfutil.decode_span_columns``)
         into contiguous column buffers instead.  Gzip shards stream (probe
         open + gzip.open) and always deliver bytes."""
+        # Cross-epoch chunk cache: a repeated read of the same work item
+        # (same bytes, same schema) serves the MATERIALIZED chunks straight
+        # from memory — no IO, no CRC scan, no decode.  Misses tee their
+        # decoded chunks into the cache on the way out (materialized copies:
+        # a cached record must own its buffer, never view a shard mmap that
+        # retires with this read).
+        tee: dict | None = None
+        cache_key = None
+        if self._cache is not None:
+            cache_key = self._cache.key_for(item, self.schema,
+                                            self.binary_features)
+            hit = self._cache.get(cache_key)
+            if hit is not None:
+                nrecs = 0
+                for chunk in hit:
+                    nrecs += len(chunk)
+                    if not self._put(chunk):
+                        return  # stopped with the consumer gone
+                self._put(ShardDone(item, tag))
+                telemetry.counter("ingest.shards_read").inc()
+                telemetry.counter("ingest.records_read").inc(nrecs)
+                return
+            # Tee this read into the cache — UNLESS the item is knowably
+            # inadmissible up front (a span bigger than the whole budget):
+            # materializing copies that put() would only throw away doubles
+            # peak reader memory for zero benefit.  Whole-shard items of
+            # unknown decoded size start a tee and abandon it the moment
+            # the running byte count crosses the budget (_emit).
+            budget = self._cache.max_bytes
+            known = (item.end - item.start if isinstance(item, ShardSpan)
+                     else None)
+            if known is None or known <= budget:
+                tee = {"chunks": [], "bytes": 0, "budget": budget}
         # Zero-copy record mode maps the shard instead of read()ing it:
         # the CRC scan and the record views walk page-cache pages
         # directly, saving a full DRAM copy pass per shard — the pass
@@ -400,7 +500,8 @@ class ReaderPipeline:
                                                        name=local)
         if self.schema is not None:
             nrecs, nbytes = self._read_columnar(local, buf,
-                                                None if gz else spans, gz)
+                                                None if gz else spans, gz,
+                                                tee)
             if nrecs is None:
                 return  # stopped with the consumer gone
         elif not gz:
@@ -422,7 +523,7 @@ class ReaderPipeline:
                     chunk = (records[i:i + cr] if zc else
                              [buf[off:off + length]
                               for off, length in spans[i:i + cr]])
-                    if not self._put(chunk):
+                    if not self._emit(chunk, tee):
                         return  # stopped with the consumer gone
             else:
                 # decode INTERLEAVED with chunk pushes: per-record decode
@@ -455,17 +556,41 @@ class ReaderPipeline:
                 nrecs += 1
                 chunk.append(decode(payload) if decode is not None else payload)
                 if len(chunk) >= self.chunk_records:
-                    if not self._put(chunk):
+                    if not self._emit(chunk, tee):
                         return  # stopped with the consumer gone
                     chunk = []
-            if chunk and not self._put(chunk):
+            if chunk and not self._emit(chunk, tee):
                 return
         self._put(ShardDone(item, tag))
         telemetry.counter("ingest.shards_read").inc()
         telemetry.counter("ingest.records_read").inc(nrecs)
         telemetry.counter("ingest.bytes_read").inc(nbytes)
+        if tee is not None and tee["chunks"] is not None:
+            # the whole item decoded cleanly AND stayed under budget: its
+            # materialized chunks are now a cache entry (put re-enforces
+            # the byte bound + LRU eviction)
+            self._cache.put(cache_key, tee["chunks"], nbytes=tee["bytes"])
 
-    def _read_columnar(self, local: str, buf, spans, gz: bool):
+    def _emit(self, chunk, tee: dict | None) -> bool:
+        """Push one decoded chunk; with the cache teeing this read, append
+        a MATERIALIZED copy (owned buffers — zero-copy views die with the
+        shard buffer, a cache entry must not).  A tee whose running byte
+        count crosses the cache budget is abandoned mid-item — the copies
+        are freed immediately instead of riding to an inevitable oversize
+        rejection at put()."""
+        if tee is not None and tee["chunks"] is not None:
+            from tensorflowonspark_tpu.data import chunk_nbytes
+
+            tee["bytes"] += chunk_nbytes(chunk)
+            if tee["bytes"] > tee["budget"]:
+                tee["chunks"] = None  # inadmissible: stop copying, free now
+                telemetry.counter("ingest.cache_oversize_skips").inc()
+            else:
+                tee["chunks"].append(_materialize_chunk(chunk))
+        return self._put(chunk)
+
+    def _read_columnar(self, local: str, buf, spans, gz: bool,
+                       tee: list | None = None):
         """Columnar (schema) decode of one work item: every
         ``chunk_records`` spans become ONE ``dfutil.ColumnChunk`` — the
         native parser turns a span window into K contiguous column buffers
@@ -482,8 +607,8 @@ class ReaderPipeline:
                 window = spans[i:i + cr]
                 cols, counts = dfutil.decode_span_columns(
                     buf, window, self.schema, self.binary_features)
-                if not self._put(dfutil.ColumnChunk.from_schema(
-                        cols, counts, self.schema)):
+                if not self._emit(dfutil.ColumnChunk.from_schema(
+                        cols, counts, self.schema), tee):
                     return None, None
                 nrecs += len(window)
                 nbytes += sum(length for _, length in window)
@@ -496,16 +621,16 @@ class ReaderPipeline:
             if len(batch) >= cr:
                 cols, counts = dfutil.records_to_columns(
                     batch, self.schema, self.binary_features)
-                if not self._put(dfutil.ColumnChunk.from_schema(
-                        cols, counts, self.schema)):
+                if not self._emit(dfutil.ColumnChunk.from_schema(
+                        cols, counts, self.schema), tee):
                     return None, None
                 nrecs += len(batch)
                 batch = []
         if batch:
             cols, counts = dfutil.records_to_columns(
                 batch, self.schema, self.binary_features)
-            if not self._put(dfutil.ColumnChunk.from_schema(
-                    cols, counts, self.schema)):
+            if not self._emit(dfutil.ColumnChunk.from_schema(
+                    cols, counts, self.schema), tee):
                 return None, None
             nrecs += len(batch)
         return nrecs, nbytes

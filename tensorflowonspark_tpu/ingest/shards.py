@@ -60,6 +60,17 @@ class ShardSpan:
         return hash((self.path, self.start, self.end))
 
 
+def work_item_key(item) -> tuple:
+    """Canonical identity of one ledger work item — a whole-shard path or a
+    :class:`ShardSpan` byte range — used as the span half of the ingest
+    tier's cross-epoch chunk-cache key (``ingest/service.py``) and as the
+    provenance tag on forwarded chunks.  Two items compare equal exactly
+    when they name the same bytes of the same file."""
+    if isinstance(item, ShardSpan):
+        return (item.path, item.start, item.end)
+    return (os.fspath(item), None, None)
+
+
 def span_bytes_default() -> int:
     """The effective ``TOS_INGEST_SPAN_BYTES`` (0 disables splitting)."""
     return _env_int("TOS_INGEST_SPAN_BYTES", _DEFAULT_SPAN_BYTES, minimum=0)

@@ -76,6 +76,13 @@ class NodeConfig:
     # named (dead) executor slot; it adopts the slot's bumped incarnation,
     # fencing out its predecessor (supervisor.py).
     replace_executor_id: int = -1
+    # Decode options for the data-service tier (cluster.run(ingest_opts=...)):
+    # keyword args for ingest.service.IngestService — schema=, chunk_records=,
+    # readers=, cache_bytes=, shuffle=, ...  Only read by processes the
+    # coordinator assigns the "ingest" role (role-aware dispatch below);
+    # carried on EVERY config because role assignment is registration-order,
+    # so any launched process may become an ingest worker.
+    ingest_opts: dict | None = None
 
 
 class NodeContext:
@@ -228,8 +235,11 @@ class NodeContext:
 
     @property
     def num_data_nodes(self) -> int:
-        """Nodes that participate in the data plane (everything but evaluator)."""
-        return sum(1 for m in self.cluster_info if m["job_name"] != "evaluator")
+        """Nodes that participate in the trainer data plane — everything but
+        the evaluator sidecar and the data-service (ingest) tier, which
+        never joins trainer consensus/collectives."""
+        return sum(1 for m in self.cluster_info
+                   if m["job_name"] not in ("evaluator", "ingest"))
 
     def all_done(self, done: bool, timeout: float = 300.0) -> bool:
         """Control-plane all-reduce: True only when *every* data node is done.
@@ -460,7 +470,11 @@ def node_main(config: NodeConfig) -> int:
     # zombie predecessor of this slot (or this process, once IT is declared
     # dead) is fenced by the coordinator instead of racing its replacement.
     client.set_identity(executor_id, incarnation)
-    faultinject.set_identity(executor_id, incarnation)
+    # chaos identity includes the assigned ROLE: `role=ingest` filters let
+    # a cluster-wide TOS_FAULTINJECT spec target exactly the data-service
+    # tier even though role assignment is registration-order
+    faultinject.set_identity(executor_id, incarnation,
+                             role=ident["job_name"])
     if config.log_dir:
         # chaos-kill postmortem: a `kill` fault dumps this process's flight
         # recorder (recent spans + events) next to the job logs before the
@@ -652,7 +666,8 @@ def node_main(config: NodeConfig) -> int:
         if tb_url:
             client.update_meta(executor_id, {"tb_url": tb_url})
 
-    if config.jax_distributed and ident["job_name"] != "evaluator":
+    if config.jax_distributed and ident["job_name"] not in ("evaluator",
+                                                            "ingest"):
         # Real multi-host SPMD: one JAX process per host over DCN.  The chief
         # picks a free port on its own host and distributes it through a
         # control-plane max-reduce (everyone else contributes -1), so no node
@@ -669,7 +684,8 @@ def node_main(config: NodeConfig) -> int:
 
         from tensorflowonspark_tpu.utils.net import bound_socket
 
-        num_data = sum(1 for m in cluster_info if m["job_name"] != "evaluator")
+        num_data = sum(1 for m in cluster_info
+                       if m["job_name"] not in ("evaluator", "ingest"))
         # The chief HOLDS the port bound through the whole reduce (the long,
         # unbounded wait for peers) and releases it only at handoff to
         # jax.distributed's coordinator service — no bind-then-release window
@@ -707,13 +723,24 @@ def node_main(config: NodeConfig) -> int:
         incarnation=incarnation,
     )
 
+    # Role-aware dispatch: a process the coordinator assigned the "ingest"
+    # role runs the data-service worker loop instead of the user map_fun —
+    # role assignment is registration-order, so the dispatch must key on
+    # the ASSIGNED role, never on which config launched the process.
+    if ident["job_name"] == "ingest":
+        from tensorflowonspark_tpu.ingest.service import ingest_worker_main
+
+        effective_map_fun = ingest_worker_main
+    else:
+        effective_map_fun = config.map_fun
+
     exit_code = 0
     try:
         logger.info("node %d (%s:%d) invoking map_fun", executor_id, ident["job_name"], ident["task_index"])
         from tensorflowonspark_tpu import telemetry
 
         with telemetry.timed("node.map_fun_secs"):
-            config.map_fun(config.tf_args, ctx)
+            effective_map_fun(config.tf_args, ctx)
     except Exception:
         tb = traceback.format_exc()
         logger.error("map_fun failed:\n%s", tb)

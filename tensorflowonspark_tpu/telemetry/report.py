@@ -162,6 +162,27 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
             "frontend_loop_lag_p99_ms": _hist_ms(
                 aggregated, "serve.frontend.loop_lag_secs", "p99"),
         }
+    ingest_tier = None
+    fwd_rows = counters.get("ingest.rows_forwarded")
+    cache_hits = counters.get("ingest.cache_hits", 0)
+    cache_misses = counters.get("ingest.cache_misses", 0)
+    if fwd_rows or cache_hits or cache_misses:
+        # the disaggregated data-service tier ran (or the chunk cache was
+        # live node-locally): the run's ingest postmortem block
+        ingest_tier = {
+            "chunks_forwarded": counters.get("ingest.chunks_forwarded"),
+            "rows_forwarded": fwd_rows,
+            "forwarded_mb": (
+                round(counters["ingest.bytes_forwarded"] / 1e6, 3)
+                if counters.get("ingest.bytes_forwarded") else None),
+            "cache_hits": cache_hits,
+            "cache_misses": cache_misses,
+            "cache_hit_rate": (
+                round(cache_hits / (cache_hits + cache_misses), 4)
+                if (cache_hits + cache_misses) else None),
+            "cache_evictions": counters.get("ingest.cache_evictions", 0),
+            "forward_errors": counters.get("ingest.forward_errors", 0),
+        }
     report: dict[str, Any] = {
         "schema": "tos-run-report-v1",
         "written_at": time.time(),
@@ -176,6 +197,7 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
             round(ingest_bytes / wall_secs / 1e6, 3)
             if ingest_bytes and wall_secs else None),
         "records_ingested": counters.get("ingest.records_read"),
+        "ingest_tier": ingest_tier,
         "rows_fed": counters.get("dataplane.rows_in"),
         "rows_consumed": counters.get("feed.rows_consumed"),
         "serving": serving,

@@ -93,6 +93,21 @@ _ALL = (
     Knob("TOS_FS_ROOTS", "str", "(unset: no mappings)",
          "scheme=root remote-filesystem mappings (os.pathsep-separated) "
          "carrying register_fs_root() into node processes."),
+    Knob("TOS_INGEST_CACHE_BYTES", "int", "0 (disabled)",
+         "Data-service tier: cross-epoch decoded-chunk cache budget per "
+         "ingest worker (payload bytes, LRU); repeated-epoch reads of the "
+         "same shard span + schema serve from memory instead of "
+         "re-decoding.  0 disables the cache."),
+    Knob("TOS_INGEST_SHUFFLE", "bool", "1",
+         "Data-service tier: 1 deals each worker's decoded chunks "
+         "round-robin across ALL trainers (global shuffle — every "
+         "trainer's stream interleaves every shard the pool claims); 0 "
+         "pins each worker to one trainer (locality mode)."),
+    Knob("TOS_INGEST_WORKERS", "int", "0 (node-local ingest)",
+         "Data-service tier size: cluster.run() default for the number of "
+         "standalone ingest-worker nodes (role='ingest') that claim the "
+         "DIRECT-mode ledger's shard items, decode on their own cores, "
+         "and stream chunks to trainers; 0 keeps decode node-local."),
     Knob("TOS_INGEST_AUTOTUNE", "bool", "1",
          "DIRECT-mode ingest: autotune reader parallelism from decode-queue "
          "occupancy (start at 1, grow while the consumer starves, shrink "

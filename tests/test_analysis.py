@@ -213,6 +213,36 @@ def test_dial_quiet_in_collective_transport_and_on_zerocopy_io_there():
     assert lint(src, f"{PKG}/collective/transport.py", "dial-discipline") == []
 
 
+def test_dial_fires_on_ingest_peer_sockets():
+    """Disaggregated-ingest satellite: worker->trainer chunk streams are
+    confined to the dataserver transport homes — raw sockets (even the
+    otherwise-sanctioned dial helpers) fire anywhere under ingest/."""
+    found = lint(
+        """
+        import socket
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+        def forward(addr):
+            c = connect_with_backoff(addr)
+            s = socket.socket()
+            return c, s
+        """, f"{PKG}/ingest/service.py", "dial-discipline")
+    assert {f.anchor for f in found} == {
+        "forward@connect_with_backoff", "forward@socket"}
+    assert all("transport homes" in f.message for f in found)
+
+
+def test_dial_quiet_on_ingest_dataclient_forwarding():
+    """The compliant shape: the forwarder speaks DataClient (dataserver.py
+    owns the socket) — nothing under ingest/ fires."""
+    src = """
+        from tensorflowonspark_tpu.dataserver import DataClient
+        def forward(host, port, authkey, chunk):
+            client = DataClient(host, port, authkey)
+            return client.forward_chunks([chunk])
+        """
+    assert lint(src, f"{PKG}/ingest/service.py", "dial-discipline") == []
+
+
 # -- lock discipline ----------------------------------------------------------
 
 _MIXED = """

@@ -49,6 +49,23 @@ def test_bench_zerocopy_and_columnar_compare_quick(tmp_path):
     assert col["speedup_x"] > 0
 
 
+def test_bench_disagg_scenario_quick(tmp_path):
+    """Round-15 machinery: the disaggregated tier and node-local legs both
+    deliver exact trainer-side counts (the runner raises on mismatch), the
+    cache compare runs both epochs, and the markdown renders."""
+    res = bench_ingest.bench_disagg(quick=True,
+                                    data_dir=str(tmp_path / "svc"))
+    assert res["node_local"]["rows_per_s"] > 0
+    assert res["disagg_w2"]["rows_per_s"] > 0
+    assert res["disagg_w2"]["num_workers"] == 2
+    cache = res["cache_epochs"]
+    assert cache["cold"]["rows"] == cache["warm"]["rows"] == res["records"]
+    assert cache["warm_over_cold"] > 1.0  # the repeated epoch must win
+    assert cache["cache"]["entries"] > 0
+    out = bench_ingest.markdown_r15(res)
+    assert "disaggregated ingest tier" in out
+
+
 def test_bench_bigshard_scenario_quick(tmp_path):
     """Single-large-shard scenario: the shard actually splits into span
     items and every cell (split N=1/N=2, whole-shard N=2) keeps exact
