@@ -37,12 +37,21 @@ coordinator RECOVERY TIME: crash -> journal replay -> rebind -> first
 post-failover rendezvous completing, the window a `kill_coordinator` chaos
 run actually rides out.
 
+Round 17 (ISSUE 15) adds the GRAY-FAILURE numbers: ``--scenario r16``
+measures (a) the stall -> suspicion -> quorum-eviction -> degraded-world
+resume latency with one member wedged mid-all-reduce (the number that
+replaces "ride out TOS_COLLECTIVE_TIMEOUT and thrash"), and (b) the
+steady-state cost of the per-peer contribution-timing bookkeeping the
+detection rides on (interleaved detect-on/off rounds in one run; the bar
+is <= 2%).
+
 Usage::
 
     python bench_collective.py                      # full run, markdown + JSON
     python bench_collective.py --quick              # tiny sizes (CI smoke)
     python bench_collective.py --json BENCH_r13.json
     python bench_collective.py --scenario r14 --json BENCH_r14.json
+    python bench_collective.py --scenario r16 --json BENCH_r16.json
 """
 
 from __future__ import annotations
@@ -325,6 +334,249 @@ def bench_recovery(slots: int = 8, tail_records: int = 512,
             "samples": samples}
 
 
+def _gray_node_main(conn, coord_addr, authkey: bytes, world: int,
+                    payload_elems: int, rounds: int, stall_round: int,
+                    stall_secs: float, timeout: float) -> None:
+    """Child for the r16 eviction-latency cell: every member runs `rounds`
+    all-reduces with reform-on-abort; the member ASSIGNED eid 1 goes gray
+    (sleeps) at `stall_round`.  Survivors report the wall time from the
+    stalled round's start to their first COMPLETED degraded-world
+    all-reduce — the stall -> detect -> evict -> resume window."""
+    import time as _time
+
+    from tensorflowonspark_tpu.collective import (
+        CollectiveAborted,
+        CollectiveGroup,
+    )
+    from tensorflowonspark_tpu.coordinator import CoordinatorClient
+    from tensorflowonspark_tpu.dataserver import DataServer
+    from tensorflowonspark_tpu.feeding import FeedQueues
+
+    queues = FeedQueues(capacity=8)
+    server = DataServer(queues, authkey, feed_timeout=timeout)
+    port = server.start()
+    client = CoordinatorClient(coord_addr, authkey=authkey)
+    ident = client.register({"host": "127.0.0.1", "data_port": port,
+                             "pid": os.getpid()})
+    eid = int(ident["executor_id"])
+    client.set_identity(eid, int(ident.get("incarnation", 0)))
+    group = CollectiveGroup(coord_addr, authkey, eid, world,
+                            "127.0.0.1", port, name="gray16",
+                            timeout=timeout)
+    victim = eid == 1
+    arr = np.full(payload_elems, 1.0, np.float32)
+    stall_to_resume = None
+    t_stall_start = None
+    done_rounds = 0
+    try:
+        group.form()
+        r = 0
+        while r < rounds:
+            if victim and r == stall_round:
+                _time.sleep(stall_secs)  # the gray failure
+            t0 = time.perf_counter()
+            try:
+                out = group.all_reduce(arr)
+            except CollectiveAborted:
+                if t_stall_start is None:
+                    t_stall_start = t0
+                try:
+                    group.reform(timeout=6.0)
+                except CollectiveAborted:
+                    break  # evicted: fenced through probation — bow out
+                continue
+            if not np.all(out == np.float32(group.effective_world)):
+                raise RuntimeError("corrupted degraded-world all-reduce")
+            if t_stall_start is not None and stall_to_resume is None:
+                stall_to_resume = time.perf_counter() - t_stall_start
+            done_rounds += 1
+            r += 1
+        conn.send({"eid": eid, "victim": victim, "rounds": done_rounds,
+                   "world": group.effective_world,
+                   "stall_to_resume": stall_to_resume})
+    except BaseException as e:  # noqa: BLE001 - surfaced driver-side
+        conn.send(RuntimeError(f"gray bench node failed: {e!r}"))
+        raise
+    finally:
+        group.close()
+        client.close()
+        server.stop()
+
+
+def bench_eviction_latency(world: int = 3, payload_mb: float = 4.0,
+                           rounds: int = 8, stall_round: int = 3,
+                           stall_secs: float = 20.0,
+                           timeout: float = 120.0) -> dict:
+    """The headline r16 number: one member wedges mid-run; how long until
+    the survivors are training again at W-1?  The baseline this replaces:
+    every round stalls the full TOS_COLLECTIVE_TIMEOUT (default 120s) and
+    reform re-admits the straggler — thrash, forever."""
+    from tensorflowonspark_tpu.coordinator import CoordinatorServer
+
+    payload_elems = max(1, int(payload_mb * (1 << 20)) // 4)
+    authkey = b"bench-gray"
+    prior_probation = os.environ.get("TOS_COLLECTIVE_PROBATION_SECS")
+    os.environ["TOS_COLLECTIVE_PROBATION_SECS"] = "600"  # victim stays out
+    coord = CoordinatorServer(world, authkey=authkey)
+    addr = coord.start("127.0.0.1")
+    ctx = mp.get_context("fork")
+    procs, conns = [], []
+    try:
+        for _ in range(world):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_gray_node_main,
+                            args=(child, addr, authkey, world, payload_elems,
+                                  rounds, stall_round, stall_secs, timeout),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+            conns.append(parent)
+        reports = []
+        for conn in conns:
+            got = conn.recv()
+            if isinstance(got, BaseException):
+                raise got
+            reports.append(got)
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        coord.stop()
+        if prior_probation is None:
+            os.environ.pop("TOS_COLLECTIVE_PROBATION_SECS", None)
+        else:
+            os.environ["TOS_COLLECTIVE_PROBATION_SECS"] = prior_probation
+    survivors = [r for r in reports if not r["victim"]]
+    assert survivors and all(r["rounds"] == rounds for r in survivors), reports
+    assert all(r["world"] == world - 1 for r in survivors), reports
+    resume = max(r["stall_to_resume"] for r in survivors)
+    evictions = [e["eid"] for e in coord.evictions()]
+    return {
+        "world": world, "payload_mb": payload_mb, "rounds": rounds,
+        "stall_secs": stall_secs,
+        "evicted": evictions,
+        "stall_to_resume_secs": round(resume, 2),
+        "baseline_timeout_thrash_secs": 120.0,
+        "speedup_vs_timeout_x": round(120.0 / resume, 1),
+    }
+
+
+def _detect_node_main(conn, coord_addr, authkey: bytes, world: int,
+                      payload_elems: int, repeats: int,
+                      bucket_bytes: int, timeout: float) -> None:
+    """Child for the r16 overhead cell: `repeats` all-reduce PAIRS, each
+    pair one detection-ON and one detection-OFF round back to back (round
+    parity toggles `tp.detect` identically on every node — no coordination
+    needed), barrier-aligned so box drift hits both cells equally."""
+    from tensorflowonspark_tpu.collective import CollectiveGroup
+    from tensorflowonspark_tpu.coordinator import CoordinatorClient
+    from tensorflowonspark_tpu.dataserver import DataServer
+    from tensorflowonspark_tpu.feeding import FeedQueues
+
+    queues = FeedQueues(capacity=8)
+    server = DataServer(queues, authkey, feed_timeout=timeout)
+    port = server.start()
+    client = CoordinatorClient(coord_addr, authkey=authkey)
+    ident = client.register({"host": "127.0.0.1", "data_port": port,
+                             "pid": os.getpid()})
+    eid = int(ident["executor_id"])
+    client.set_identity(eid, int(ident.get("incarnation", 0)))
+    group = CollectiveGroup(coord_addr, authkey, eid, world,
+                            "127.0.0.1", port, name="detect16",
+                            timeout=timeout, bucket_bytes=bucket_bytes)
+    try:
+        group.form()
+        arr = np.full(payload_elems, float(eid + 1), np.float32)
+        expect = np.float32(world * (world + 1) / 2.0)
+        group.all_reduce(arr)  # warmup: dials, attaches, TCP autotune
+        times: dict[str, list[float]] = {"detect_on": [], "detect_off": []}
+        for i in range(repeats * 2):
+            on = i % 2 == 0
+            group._tp.detect = on
+            group.barrier()
+            t0 = time.perf_counter()
+            out = group.all_reduce(arr)
+            dt = time.perf_counter() - t0
+            if not np.all(out == expect):
+                raise RuntimeError("corrupted all-reduce in overhead cell")
+            times["detect_on" if on else "detect_off"].append(dt)
+        group._tp.detect = True
+        conn.send({"eid": eid, "times": times})
+    except BaseException as e:  # noqa: BLE001 - surfaced driver-side
+        conn.send(RuntimeError(f"detect bench node failed: {e!r}"))
+        raise
+    finally:
+        group.close()
+        client.close()
+        server.stop()
+
+
+def bench_detect_compare(world: int = 2, payload_mb: float = 4.0,
+                         repeats: int = 24, bucket_bytes: int = 4 << 20,
+                         timeout: float = 120.0) -> dict:
+    """Steady-state cost of the per-peer timing bookkeeping (detection ON
+    vs OFF), interleaved round-by-round in ONE run so box drift hits both
+    cells equally — the satellite bar is <= 2%."""
+    from tensorflowonspark_tpu.coordinator import CoordinatorServer
+
+    payload_elems = max(1, int(payload_mb * (1 << 20)) // 4)
+    authkey = b"bench-detect"
+    coord = CoordinatorServer(world, authkey=authkey)
+    addr = coord.start("127.0.0.1")
+    ctx = mp.get_context("fork")
+    procs, conns = [], []
+    try:
+        for _ in range(world):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_detect_node_main,
+                            args=(child, addr, authkey, world, payload_elems,
+                                  repeats, bucket_bytes, timeout),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+            conns.append(parent)
+        reports = []
+        for conn in conns:
+            got = conn.recv()
+            if isinstance(got, BaseException):
+                raise got
+            reports.append(got)
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        coord.stop()
+    out: dict = {"world": world, "payload_mb": payload_mb,
+                 "repeats": repeats}
+    for cell in ("detect_on", "detect_off"):
+        round_times = [max(r["times"][cell][i] for r in reports)
+                       for i in range(repeats)]
+        out[cell] = {
+            "seconds_median": round(statistics.median(round_times), 5),
+            "agg_mb_per_s": round(
+                world * payload_elems * 4
+                / statistics.median(round_times) / 1e6, 1),
+        }
+    on, off = (out["detect_on"]["seconds_median"],
+               out["detect_off"]["seconds_median"])
+    out["overhead_pct"] = round(100.0 * (on - off) / off, 2)
+    return out
+
+
+def bench_r16(payload_mb: float = 4.0, repeats: int = 24,
+              stall_secs: float = 20.0) -> dict:
+    """The BENCH_r16 scenario (ISSUE 15): gray-failure eviction latency +
+    detection-bookkeeping overhead."""
+    return {
+        "schema": "tos-bench-collective-r16",
+        "eviction": bench_eviction_latency(stall_secs=stall_secs),
+        "detect_overhead": bench_detect_compare(payload_mb=payload_mb,
+                                                repeats=repeats),
+    }
+
+
 def bench_r14(rounds: int = 300, tail_records: int = 512,
               repeats: int = 5) -> dict:
     """The BENCH_r14 scenario (ISSUE 13): what the write-ahead journal
@@ -359,6 +611,29 @@ def markdown_r14(result: dict) -> str:
     ])
 
 
+def markdown_r16(result: dict) -> str:
+    ev, ov = result["eviction"], result["detect_overhead"]
+    return "\n".join([
+        f"gray stall (W={ev['world']}, {ev['payload_mb']} MB payload, "
+        f"{ev['stall_secs']}s wedge): evicted {ev['evicted']}, "
+        f"stall -> detect -> evict -> degraded resume "
+        f"{ev['stall_to_resume_secs']}s "
+        f"(x{ev['speedup_vs_timeout_x']} vs the "
+        f"{ev['baseline_timeout_thrash_secs']:.0f}s timeout-thrash "
+        "baseline)",
+        "",
+        "| cell | round median s | agg MB/s |",
+        "|---|---|---|",
+        f"| detect on | {ov['detect_on']['seconds_median']} "
+        f"| {ov['detect_on']['agg_mb_per_s']} |",
+        f"| detect off | {ov['detect_off']['seconds_median']} "
+        f"| {ov['detect_off']['agg_mb_per_s']} |",
+        "",
+        f"per-peer timing bookkeeping overhead: {ov['overhead_pct']}% "
+        f"({ov['repeats']} interleaved pairs, bar <= 2%)",
+    ])
+
+
 def markdown_table(result: dict) -> str:
     rows = [
         "| algo | median s | agg MB/s | algbw MB/s |",
@@ -385,15 +660,22 @@ def main(argv=None) -> int:
     ap.add_argument("--payload-mb", type=float, default=None)
     ap.add_argument("--repeats", type=int, default=None)
     ap.add_argument("--bucket-mb", type=float, default=4.0)
-    ap.add_argument("--scenario", choices=("single", "r13", "r14"),
+    ap.add_argument("--scenario", choices=("single", "r13", "r14", "r16"),
                     default="single")
     ap.add_argument("--rounds", type=int, default=300,
                     help="r14: interleaved journal-compare rendezvous rounds")
     ap.add_argument("--tail-records", type=int, default=512,
                     help="r14: journal tail size replayed by the recovery cell")
+    ap.add_argument("--stall-secs", type=float, default=20.0,
+                    help="r16: how long the gray member wedges")
     ap.add_argument("--json", default=None, help="write results JSON here")
     args = ap.parse_args(argv)
-    if args.scenario == "r14":
+    if args.scenario == "r16":
+        result = bench_r16(payload_mb=args.payload_mb or 4.0,
+                           repeats=args.repeats or 24,
+                           stall_secs=args.stall_secs)
+        print(markdown_r16(result))
+    elif args.scenario == "r14":
         result = bench_r14(rounds=args.rounds,
                            tail_records=args.tail_records,
                            repeats=args.repeats or 5)

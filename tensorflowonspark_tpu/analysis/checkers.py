@@ -670,6 +670,58 @@ class ReactorDisciplineChecker(Checker):
                 self.hint, f"{qual}@block:{name}")
 
 
+@register_checker
+class TimeoutDisciplineChecker(Checker):
+    """Every blocking wait in ``collective/`` must be bounded.
+
+    The gray-failure machinery (straggler detection, quorum eviction,
+    degraded-world continuation) only works because NO wait in the
+    collective layer can exceed one collective timeout: an unbounded
+    ``fut.result()``, ``cond.wait()``, or default-budget peer ``recv``
+    turns one slow peer into a wedged trainer no eviction can rescue.
+    This pins the invariant mechanically: ``result``/``wait`` calls need a
+    timeout (keyword or positional), and peer-plane ``recv`` calls must
+    pass their budget EXPLICITLY (the ops layer derives per-op deadlines —
+    relying on an implicit transport default hides the bound from the
+    reader and from this checker alike)."""
+
+    id = "timeout-discipline"
+    hint = ("bound the wait: fut.result(timeout=...), cond.wait(secs), "
+            "tp.recv(..., timeout=_left(deadline)) — an unbounded block in "
+            "collective/ turns one gray peer into an unevictable wedge")
+
+    _WAIT_ATTRS = frozenset({"result", "wait", "recv"})
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        if "/collective/" not in mod.path:
+            return
+        for node, scope in _scoped_walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr not in self._WAIT_ATTRS:
+                continue
+            bounded_kw = any(
+                kw.arg == "timeout"
+                and not (isinstance(kw.value, ast.Constant)
+                         and kw.value.value is None)
+                for kw in node.keywords)
+            if attr == "recv":
+                # inbox.recv carries a required positional timeout (5 args);
+                # transport-level recv must say its budget out loud
+                if bounded_kw or len(node.args) >= 4:
+                    continue
+            elif bounded_kw or node.args:
+                continue
+            yield Finding(
+                self.id, mod.path, node.lineno,
+                f"unbounded blocking {attr}() in the collective layer — a "
+                "gray (slow-not-dead) peer wedges this wait past any "
+                "eviction",
+                self.hint, f"{_qual(scope)}@{attr}")
+
+
 # -- 4. silent-exception discipline ------------------------------------------
 
 

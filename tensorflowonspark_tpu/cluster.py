@@ -577,9 +577,68 @@ class TPUCluster:
                                "node %d died holding", n, executor_id)
         self._drop_client(executor_id, abort=True)
 
+    def _handle_collective_events(self) -> None:
+        """React to gray-failure evictions/readmissions the coordinator
+        adjudicated (quorum of survivor suspicion votes): an EVICTED slot's
+        process is alive-but-benched, so the supervisor PARKS it (no
+        respawn — a replacement would split-brain the slot) and its ledger
+        slot retires (queued partitions rebalance to survivors, exactly the
+        scale-in machinery); a READMITTED slot unparks and — when a train()
+        is live — grows back in through the scale-out attach path.  A
+        benched process that stops heartbeating altogether is REAPED into
+        an ordinary death (eviction must not hide a real corpse forever):
+        unparked and handed to the supervisor like any other death."""
+        self.coordinator.reap_silent_probation(self._dead_after)
+        for ev in self.coordinator.drain_collective_events():
+            eid = int(ev["eid"])
+            if ev["kind"] == "evicted":
+                logger.warning("node %d evicted from collective group %r "
+                               "(gray failure); benching its feed slot",
+                               eid, ev.get("group"))
+                if self.supervisor is not None:
+                    self.supervisor.park(eid)
+                self._evict_slot_work(eid)
+            elif ev["kind"] == "readmitted":
+                if self.supervisor is not None:
+                    self.supervisor.unpark(eid)
+                if self._attach_train_slot(eid):
+                    logger.info("readmitted node %d re-attached to the "
+                                "live feed", eid)
+            elif ev["kind"] == "probation_death":
+                self._requeue_dead_slot(eid)
+                if self.supervisor is not None:
+                    self.supervisor.unpark(eid)
+                    self.supervisor.handle_death(eid)
+
+    def _evict_slot_work(self, executor_id: int) -> None:
+        """Rebalance an evicted slot's feed work onto survivors: retire its
+        ledger slot (no new assignments; queued partitions move — the
+        autoscale retire machinery), re-deliver its in-flight and
+        buffered-but-unconsumed window, and drop its cached data client so
+        no feed worker stays wedged against the benched peer.  The PROCESS
+        stays alive in probation; readmission re-attaches a fresh slot."""
+        with self._train_lock:
+            entry = self._active_ledger.pop(executor_id, None)
+        if entry is None:
+            return
+        ledger, pos = entry
+        ledger.requeue(pos)
+        moved = ledger.retire_slot(pos)
+        n = ledger.requeue_unconsumed(pos)
+        if moved or n:
+            logger.warning("evicted node %d: %d queued partition(s) "
+                           "rebalanced to survivors, %d buffered "
+                           "re-delivered", executor_id, moved, n)
+        self._drop_client(executor_id, abort=True)
+
     def _monitor_loop(self) -> None:
         poll = max(1.0, self.heartbeat_interval)
         while not self._monitor_stop.wait(poll):
+            try:
+                self._handle_collective_events()
+            except Exception:  # noqa: BLE001 - eviction bookkeeping must not kill the monitor
+                logger.warning("collective eviction bookkeeping failed",
+                               exc_info=True)
             newly = self._record_deaths(
                 record_error=(self.supervisor is None))
             # Retiring slots first: their death mid-drain is part of the

@@ -39,10 +39,35 @@ runs concurrently with the host-side device_get/pack of bucket *k+1*, so
 communication overlaps the tail of backprop instead of serializing after
 it.
 
-Known limitation: a fenced-but-alive zombie (dropped heartbeats, not a
-death) is excluded from every coordinator op but can still move bytes on
-the peer plane until the next reform bumps the generation; SIGKILL-style
-deaths (the chaos-tested path) never reach that window.
+Gray failures — stragglers, quorum eviction, degraded worlds
+------------------------------------------------------------
+
+Deaths are the easy case; a *slow-but-alive* member (GC pause, one stolen
+core, a degraded NIC) used to stall every round for the full collective
+timeout and then thrash: reform re-admitted it at full world and the next
+round stalled again.  The gray-failure path (ISSUE 15):
+
+1. **Detection** — the transport keeps per-peer rolling contribution
+   timings; a wait running ``TOS_COLLECTIVE_SUSPECT_FACTOR`` past the
+   rolling baseline files a ``suspect`` vote with the coordinator
+   (relative, so uniform slowness never flags anyone; abort attribution —
+   the vote names the peer being waited on).
+2. **Quorum eviction** — the coordinator resolves transitive blame (a
+   member that is itself complaining about its upstream is a pipeline
+   victim, not the straggler) and at ``TOS_COLLECTIVE_EVICT_QUORUM``
+   survivor votes EVICTS: the member's incarnation is fenced and the
+   process parks in probation (``TOS_COLLECTIVE_PROBATION_SECS``) instead
+   of being respawned into the group.
+3. **Degraded-world continuation** — :meth:`form` rendezvouses at the
+   *effective* world (nominal minus evicted, coordinator-adjudicated), so
+   survivors resume at W-1 well inside one collective timeout;
+   :meth:`check_grow` notices a readmitted member and the next
+   :meth:`reform` grows the world back at a later generation barrier.
+4. **Hard peer-plane fencing** — the old known limitation (a fenced-but-
+   alive zombie could keep moving bytes on the peer plane until the next
+   reform) is closed: survivors' inboxes reject frames by (generation,
+   membership), refuse attaches from non-members, and actively sever an
+   evicted peer's attach connections at reconfigure.
 """
 
 from __future__ import annotations
@@ -59,7 +84,7 @@ from tensorflowonspark_tpu.collective.transport import (
     CollectiveAborted,
     PeerTransport,
 )
-from tensorflowonspark_tpu.coordinator import CoordinatorClient
+from tensorflowonspark_tpu.coordinator import CoordinatorClient, CoordinatorFenced
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int, env_str
 
@@ -107,11 +132,15 @@ class CollectiveGroup:
                  executor_id: int, world: int, host: str, data_port: int,
                  name: str = "train", incarnation: int = 0,
                  timeout: float | None = None,
-                 bucket_bytes: int | None = None):
+                 bucket_bytes: int | None = None, detect: bool = True):
         if world < 1:
             raise ValueError("collective group needs world >= 1")
         self.name = name
         self.executor_id = int(executor_id)
+        # NOMINAL world: the full membership this group was sized for.
+        # After a gray-failure eviction the group runs DEGRADED — the
+        # effective world (len(self._members), coordinator-adjudicated at
+        # each form) may be smaller until the evicted member grows back in.
         self.world = int(world)
         self.incarnation = int(incarnation)
         self._host = host
@@ -124,9 +153,19 @@ class CollectiveGroup:
         # Dedicated control-plane connection: formation rendezvous can block
         # through a whole restart window and must never wedge the node's
         # main client (heartbeats already have their own).
+        self._coordinator_addr = coordinator_addr
+        self._authkey = authkey
         self._client = CoordinatorClient(coordinator_addr, authkey=authkey)
         self._client.set_identity(self.executor_id, self.incarnation)
-        self._tp = PeerTransport(name, authkey, self._timeout)
+        self._tp = PeerTransport(name, authkey, self._timeout, detect=detect)
+        # straggler detection: the transport measures, this group reports.
+        # The vote gets its OWN lazy connection (bounded dial + call): the
+        # main client's lock can be held across a minutes-long blocking
+        # barrier, and a suspicion that cannot be filed is an eviction
+        # that never happens.
+        self._tp.set_suspect_callback(self._report_suspect)
+        self._sus_client: CoordinatorClient | None = None
+        self._grow_checked = 0.0
         # ONE comm thread: serializes all peer I/O (sends never interleave)
         # and is the overlap engine — bucket k reduces here while the caller
         # packs bucket k+1.
@@ -160,9 +199,6 @@ class CollectiveGroup:
             raise CollectiveAborted(f"collective group {self.name!r} is closed")
         budget = self._timeout if timeout is None else float(timeout)
         deadline = time.monotonic() + budget
-        me = {"eid": self.executor_id, "host": self._host,
-              "port": self._data_port, "gen": self.generation + 1,
-              "step": int(resume_step), "incarnation": self.incarnation}
         t0 = time.monotonic()
         last_err: Exception | None = None
         while True:
@@ -171,11 +207,30 @@ class CollectiveGroup:
                 raise CollectiveAborted(
                     f"collective group {self.name!r} did not form within "
                     f"{budget:.0f}s (world {self.world}): {last_err}")
+            # Degraded-world rendezvous: form at the coordinator-adjudicated
+            # EFFECTIVE world (nominal minus evicted members), re-queried
+            # every attempt — an eviction or readmission landing mid-retry
+            # is picked up at the next pass.  Re-stamped each attempt: a
+            # readmission hands this client its bumped incarnation on the
+            # reply, and the next join must carry it.
+            count = self._effective_world() or self.world
+            me = {"eid": self.executor_id, "host": self._host,
+                  "port": self._data_port, "gen": self.generation + 1,
+                  "step": int(resume_step),
+                  "incarnation": self._client.incarnation}
             try:
                 result = self._client.collective_form(
-                    f"cg.{self.name}.form", me, count=self.world,
+                    f"cg.{self.name}.form", me, count=count,
                     timeout=min(10.0, max(1.0, remaining)))
                 break
+            except CoordinatorFenced as e:
+                # EVICTED (gray failure) or genuinely fenced: ride out the
+                # probation — the coordinator readmits this process on a
+                # heartbeat once probation expires, the reply hands every
+                # client the bumped incarnation, and the next join passes.
+                # A dead slot's zombie never readmits and times out here.
+                last_err = e
+                time.sleep(0.5)
             except (RuntimeError, ConnectionError) as e:
                 # peer-abort / slice timeout / death-declaration abort /
                 # coordinator failover (CoordinatorRestarted, or the
@@ -191,6 +246,9 @@ class CollectiveGroup:
             raise CollectiveAborted(
                 f"formation of {self.name!r} completed without this node "
                 f"(executor {self.executor_id} not in {ranks})")
+        # a readmitted member adopted its bumped incarnation on the wire;
+        # the group-level view follows so peers/telemetry see the truth
+        self.incarnation = max(self.incarnation, self._client.incarnation)
         self.rank = ranks.index(self.executor_id)
         self.generation = int(result["generation"])
         self.agreed_step = int(result["step"])
@@ -207,10 +265,19 @@ class CollectiveGroup:
             time.monotonic() - t0)
         ttrace.event("collective_form", group=self.name,
                      generation=self.generation, rank=self.rank,
-                     world=self.world, step=self.agreed_step)
+                     world=len(members), nominal_world=self.world,
+                     step=self.agreed_step)
+        if len(members) < self.world:
+            telemetry.gauge("collective.degraded_world").set(len(members))
+            logger.warning(
+                "collective group %r formed DEGRADED: %d/%d members "
+                "(evicted slots excluded), generation %d",
+                self.name, len(members), self.world, self.generation)
+        else:
+            telemetry.gauge("collective.degraded_world").set(0)
         logger.info("collective group %r formed: generation %d, rank %d/%d, "
                     "agreed step %d", self.name, self.generation, self.rank,
-                    self.world, self.agreed_step)
+                    len(members), self.agreed_step)
         return self.agreed_step
 
     def reform(self, resume_step: int = 0,
@@ -239,6 +306,88 @@ class CollectiveGroup:
                 "generation; cannot safely re-form") from None
         telemetry.counter("collective.reforms_total").inc()
         return self.form(resume_step=resume_step, timeout=timeout)
+
+    # -- gray-failure detection / degraded worlds ------------------------------
+
+    @property
+    def effective_world(self) -> int:
+        """Members in the CURRENT formation (may be below the nominal
+        ``world`` while an evicted member sits in probation)."""
+        return len(self._members) if self._members else self.world
+
+    def _effective_world(self) -> int | None:
+        """Coordinator-adjudicated formation count: nominal world minus the
+        group's evicted members.  None when the query cannot answer (e.g.
+        this client is itself fenced — the form attempt will say so)."""
+        try:
+            resp = self._client.collective_world(self.name, self.world)
+        except (RuntimeError, OSError, ValueError):
+            # transient control-plane faults (incl. a post-reconnect resend
+            # failing with a raw OSError, or a torn frame's ValueError) are
+            # ridden out by the caller's retry loop, never propagated into
+            # a training step
+            return None
+        eff = resp.get("effective")
+        return int(eff) if eff is not None else None
+
+    def _suspect_channel(self) -> CoordinatorClient:
+        """Lazy dedicated connection for suspicion votes, every phase
+        bounded (single dial attempt, call timeout): the comm thread files
+        these mid-recv, and neither a busy main client nor a blackholed
+        coordinator may wedge it."""
+        if self._sus_client is None:
+            client = CoordinatorClient(
+                self._coordinator_addr, authkey=self._authkey,
+                connect_timeout=5.0, connect_attempts=1, call_timeout=10.0)
+            client.set_identity(self.executor_id, self._client.incarnation)
+            self._sus_client = client
+        return self._sus_client
+
+    def _report_suspect(self, src_rank: int, wait_secs: float) -> bool:
+        """Transport callback: file a suspicion vote against the peer this
+        node has been waiting on (abort attribution included — the vote
+        names the rank, the coordinator resolves transitive blame).  True
+        when quorum evicted a member of the CURRENT formation: the caller
+        aborts the round now and re-forms at the degraded world."""
+        members = self._tp.member_eids()
+        if not 0 <= src_rank < len(members):
+            return False
+        suspect_eid = members[src_rank]
+        try:
+            resp = self._suspect_channel().suspect(self.name, suspect_eid,
+                                                   wait_secs)
+        except (RuntimeError, OSError, ValueError):
+            # a failed vote never poisons a healthy round; drop the channel
+            # so the next report dials fresh
+            sus, self._sus_client = self._sus_client, None
+            if sus is not None:
+                try:
+                    sus.close()
+                except OSError:  # toslint: allow-silent(best-effort teardown of a failed suspicion channel)
+                    pass
+            return False
+        telemetry.counter("collective.suspects_total").inc()
+        ttrace.event("suspect", group=self.name, executor=self.executor_id,
+                     peer=suspect_eid, wait_secs=round(wait_secs, 2))
+        logger.warning("collective group %r: rank %d (executor %d) running "
+                       "%.1fs behind; suspicion filed with the coordinator",
+                       self.name, src_rank, suspect_eid, wait_secs)
+        evicted = {int(e) for e in resp.get("evicted") or ()}
+        return bool(evicted & set(members))
+
+    def check_grow(self, min_interval: float = 1.0) -> bool:
+        """Cheap grow-back poll (rate-limited to one control round-trip per
+        ``min_interval``): True when a previously evicted member has been
+        readmitted and a :meth:`reform` would stand a LARGER world at the
+        next generation barrier.  Call it at step boundaries; on True,
+        ``reform`` + ``sync_state`` level the rejoiner."""
+        now = time.monotonic()
+        if now - self._grow_checked < min_interval:
+            return False
+        self._grow_checked = now
+        eff = self._effective_world()
+        return bool(eff is not None and self._members
+                    and eff > len(self._members))
 
     # -- collectives -----------------------------------------------------------
 
@@ -315,13 +464,15 @@ class CollectiveGroup:
                                                 root=root, bucket_bytes=bb))
 
     def barrier(self, timeout: float | None = None) -> None:
-        """Control-plane barrier scoped to this group's world (generation-
-        stamped name, so a stale member can never satisfy a live one)."""
+        """Control-plane barrier scoped to this group's EFFECTIVE world
+        (generation-stamped name, so a stale member can never satisfy a
+        live one — and a degraded formation never waits on its evicted
+        member)."""
         self._client.barrier(
             f"cg.{self.name}.g{self.generation}.b{self._next_seq()}",
             self.executor_id,
             timeout=self._timeout if timeout is None else timeout,
-            count=self.world)
+            count=self.effective_world)
 
     # -- gradient buckets (the dp.make_train_step hook) ------------------------
 
@@ -417,7 +568,7 @@ class CollectiveGroup:
 
         root = self._root_rank if root is None else int(root)
         leaves, treedef = jax.tree.flatten(tree)
-        if not leaves or self.world == 1:
+        if not leaves or self.effective_world == 1:
             return tree
         buckets = _plan_buckets(leaves, self._bucket_bytes)
         out_leaves: list = list(leaves)
@@ -448,7 +599,7 @@ class CollectiveGroup:
         everyone adopts ``(its_tree, agreed_step)``.  A member already at
         the agreed step keeps its own values bit-identical (it either IS
         the root or receives the root's identical state)."""
-        if self.world == 1:
+        if self.effective_world == 1:
             return tree, int(step)
         synced = self.broadcast_tree(tree, root=self._root_rank)
         if int(step) != self.agreed_step:
@@ -472,3 +623,8 @@ class CollectiveGroup:
             self._client.close()
         except OSError:  # toslint: allow-silent(best-effort teardown of the dedicated control-plane connection)
             pass
+        if self._sus_client is not None:
+            try:
+                self._sus_client.close()
+            except OSError:  # toslint: allow-silent(best-effort teardown of the suspicion channel)
+                pass

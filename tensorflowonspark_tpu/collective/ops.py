@@ -27,6 +27,8 @@ the worst case the generation-barrier rejoin must survive.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from tensorflowonspark_tpu import faultinject
@@ -34,6 +36,20 @@ from tensorflowonspark_tpu.collective.transport import (
     CollectiveAborted,
     PeerTransport,
 )
+
+
+def _op_deadline(tp: PeerTransport) -> float:
+    """Per-OP receive deadline: every recv of one collective shares a
+    single ``tp.timeout`` budget, so a round's total blocked time is
+    bounded by one collective timeout — not one timeout per hop per chunk
+    (the multiplicative wedge a gray peer used to be able to inflict)."""
+    return time.monotonic() + tp.timeout
+
+
+def _left(deadline: float) -> float:
+    """Remaining recv budget (floored so a recv at the wire always gets a
+    beat to drain an already-delivered frame)."""
+    return max(0.05, deadline - time.monotonic())
 
 
 def _segment_bounds(n: int, world: int) -> list[int]:
@@ -84,6 +100,7 @@ def ring_all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
         return out.reshape(src.shape)
     bounds = _segment_bounds(out.size, world)
     chunk = _chunk_elems(out.itemsize, bucket_bytes)
+    deadline = _op_deadline(tp)
     right, left = (rank + 1) % world, (rank - 1) % world
     # reduce-scatter: after step s, segment (rank - s - 1) holds the partial
     # sum of s+2 ranks; after world-1 steps rank owns segment (rank+1)%world
@@ -98,11 +115,13 @@ def ring_all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
                 tp.send(right, seq, ("rs", step, k), out[lo:hi])
             if k < len(recv_spans):
                 lo, hi = recv_spans[k]
-                piece = tp.recv(left, seq, ("rs", step, k))
+                piece = tp.recv(left, seq, ("rs", step, k),
+                                timeout=_left(deadline))
                 if hi > lo:
                     out[lo:hi] += np.asarray(piece).reshape(-1)
     # mid-all-reduce chaos seam: partial sums are committed, the all-gather
-    # exchange is still ahead — a SIGKILL here leaves chunks in flight
+    # exchange is still ahead — a SIGKILL (or gray stall) here leaves
+    # chunks in flight
     faultinject.collective_round()
     # all-gather: circulate the finished segments
     for step in range(world - 1):
@@ -116,7 +135,8 @@ def ring_all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
                 tp.send(right, seq, ("ag", step, k), out[lo:hi])
             if k < len(recv_spans):
                 lo, hi = recv_spans[k]
-                piece = tp.recv(left, seq, ("ag", step, k))
+                piece = tp.recv(left, seq, ("ag", step, k),
+                                timeout=_left(deadline))
                 if hi > lo:
                     out[lo:hi] = np.asarray(piece).reshape(-1)
     if average:
@@ -147,9 +167,11 @@ def naive_all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
     if world <= 1:
         faultinject.collective_round()
         return out.reshape(src.shape)
+    deadline = _op_deadline(tp)
     if rank == 0:
         for peer in range(1, world):
-            piece = tp.recv(peer, seq, ("gb", "up"))
+            piece = tp.recv(peer, seq, ("gb", "up"),
+                            timeout=_left(deadline))
             out += np.asarray(piece).reshape(-1)
         faultinject.collective_round()
         if average:
@@ -159,7 +181,8 @@ def naive_all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
         return out.reshape(src.shape)
     tp.send(0, seq, ("gb", "up"), out)
     faultinject.collective_round()
-    reduced = np.asarray(tp.recv(0, seq, ("gb", "down")))
+    reduced = np.asarray(tp.recv(0, seq, ("gb", "down"),
+                                 timeout=_left(deadline)))
     return np.array(reduced, copy=True).reshape(src.shape)
 
 
@@ -176,6 +199,7 @@ def reduce_scatter(tp: PeerTransport, arr: np.ndarray, *, seq: int,
         return 0, out.reshape(src.shape)
     bounds = _segment_bounds(out.size, world)
     chunk = _chunk_elems(out.itemsize, bucket_bytes)
+    deadline = _op_deadline(tp)
     right, left = (rank + 1) % world, (rank - 1) % world
     for step in range(world - 1):
         si = (rank - step) % world
@@ -188,7 +212,8 @@ def reduce_scatter(tp: PeerTransport, arr: np.ndarray, *, seq: int,
                 tp.send(right, seq, ("rs", step, k), out[lo:hi])
             if k < len(recv_spans):
                 lo, hi = recv_spans[k]
-                piece = tp.recv(left, seq, ("rs", step, k))
+                piece = tp.recv(left, seq, ("rs", step, k),
+                                timeout=_left(deadline))
                 if hi > lo:
                     out[lo:hi] += np.asarray(piece).reshape(-1)
     own = (rank + 1) % world
@@ -208,11 +233,13 @@ def all_gather(tp: PeerTransport, arr: np.ndarray, *,
         return [np.array(own, copy=True)]
     out: list = [None] * world
     out[rank] = np.array(own, copy=True)
+    deadline = _op_deadline(tp)
     right, left = (rank + 1) % world, (rank - 1) % world
     cur = own
     for step in range(world - 1):
         tp.send(right, seq, ("ag", step), cur)
-        cur = np.asarray(tp.recv(left, seq, ("ag", step)))
+        cur = np.asarray(tp.recv(left, seq, ("ag", step),
+                                 timeout=_left(deadline)))
         out[(rank - step - 1) % world] = np.array(cur, copy=True)
     return out
 
@@ -243,13 +270,15 @@ def broadcast(tp: PeerTransport, arr: np.ndarray | None, *, seq: int,
         for k, (lo, hi) in enumerate(spans):
             tp.send(right, seq, ("bc", k), flat[lo:hi])
         return np.array(np.asarray(arr), copy=True)
+    deadline = _op_deadline(tp)
     left = (rank - 1) % world
-    header = tp.recv(left, seq, ("bc", "hdr"))
+    header = tp.recv(left, seq, ("bc", "hdr"), timeout=_left(deadline))
     if rank != last:
         tp.send(right, seq, ("bc", "hdr"), header)
     pieces = []
     for k in range(int(header["chunks"])):
-        piece = np.asarray(tp.recv(left, seq, ("bc", k)))
+        piece = np.asarray(tp.recv(left, seq, ("bc", k),
+                                   timeout=_left(deadline)))
         if rank != last:
             tp.send(right, seq, ("bc", k), piece)
         pieces.append(piece.reshape(-1))

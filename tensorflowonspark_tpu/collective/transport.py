@@ -53,6 +53,13 @@ class CollectiveAborted(RuntimeError):
     new generation barrier, and resync state before continuing."""
 
 
+class CollectiveTimeout(CollectiveAborted):
+    """The specific abort where a receive TIMED OUT waiting on one peer —
+    distinguished so the straggler-detection recv loop can keep slicing
+    (and reporting suspicion) without mistaking a peer-failure poison or a
+    group close for mere slowness."""
+
+
 # -- inbox registry (the dataserver's attach handler looks groups up here) ----
 
 _registry_lock = threading.Lock()
@@ -97,24 +104,78 @@ class CollectiveInbox:
         # receives at or below it abort fast, above it are a NEW connection
         self._failed: dict[int, int] = {}
         self._generation = 0
+        # Membership fence (gray-failure eviction): the eids of the CURRENT
+        # formation and its world size.  A frame at the current generation
+        # from a rank outside the live world is an evicted (or otherwise
+        # fenced) peer still moving bytes — dropped, and its attach
+        # connection severed.  None until the first formation.
+        self._member_eids: set[int] | None = None
+        self._world = 0
+        # eid -> attach connections feeding this inbox (the dataserver hands
+        # them over); tracked so eviction can HARD-SEVER a non-member's wire
+        # instead of letting a zombie stream into the void forever.
+        self._attach_conns: dict[int, list] = {}
         self._closed = False
 
-    def advance_generation(self, generation: int) -> None:
+    def advance_generation(self, generation: int,
+                           member_eids: list[int] | None = None) -> None:
         """A new formation completed: drop every stale-generation frame and
         failure record (fencing — a poisoned round's leftovers must never
-        feed a live one)."""
+        feed a live one), adopt the live membership, and sever any attach
+        connection from a peer that is no longer a member (the documented
+        zombie window: a fenced-but-alive peer keeps its socket open and
+        keeps moving bytes — close OUR end so it stops here)."""
+        stale: list = []
         with self._cond:
             self._generation = generation
             self._frames = {k: v for k, v in self._frames.items()
                             if k[0] >= generation}
             self._failed = {s: g for s, g in self._failed.items()
                             if g >= generation}
+            if member_eids is not None:
+                self._member_eids = {int(e) for e in member_eids}
+                self._world = len(self._member_eids)
+                for eid in list(self._attach_conns):
+                    if eid >= 0 and eid not in self._member_eids:
+                        stale.extend(self._attach_conns.pop(eid))
             self._cond.notify_all()
+        for conn in stale:
+            with contextlib.suppress(OSError):
+                conn.close()
+        if stale:
+            telemetry.counter("collective.severed_conns").inc(len(stale))
+
+    def admits(self, src_eid: int, generation: int) -> bool:
+        """Attach-time membership gate: a peer that is NOT in the current
+        formation may only attach for a LATER generation (a readmitted
+        member racing slightly ahead of our own reconfigure); at or below
+        the current generation it is fenced out."""
+        with self._cond:
+            if self._member_eids is None or src_eid < 0:
+                return True
+            if src_eid in self._member_eids:
+                return True
+            return generation > self._generation
+
+    def note_attach(self, src_eid: int, conn) -> None:
+        with self._cond:
+            self._attach_conns.setdefault(src_eid, []).append(conn)
+
+    def forget_attach(self, src_eid: int, conn) -> None:
+        with self._cond:
+            conns = self._attach_conns.get(src_eid)
+            if conns and conn in conns:
+                conns.remove(conn)
+                if not conns:
+                    del self._attach_conns[src_eid]
 
     def deliver(self, generation: int, src: int, seq: int, tag, payload) -> None:
         with self._cond:
             if self._closed or generation < self._generation:
                 return  # fenced: a stale round's frame
+            if generation == self._generation and self._world \
+                    and not 0 <= src < self._world:
+                return  # fenced: a non-member rank's frame (evicted zombie)
             self._frames.setdefault((generation, src, seq, tag),
                                     collections.deque()).append(payload)
             self._cond.notify_all()
@@ -157,7 +218,7 @@ class CollectiveInbox:
                         f"{generation}); round poisoned")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise CollectiveAborted(
+                    raise CollectiveTimeout(
                         f"timed out after {timeout:.0f}s waiting for chunk "
                         f"{tag!r} from rank {src} (generation {generation})")
                 self._cond.wait(min(0.5, remaining))
@@ -166,27 +227,39 @@ class CollectiveInbox:
 # -- attach-side receive loop (runs on a dataserver connection thread) --------
 
 
-def attach_error(name: str) -> str | None:
+def attach_error(name: str, src_eid: int = -1,
+                 generation: int = 0) -> str | None:
     """Validation half of the dataserver's ``collective_attach`` op: None
-    when the named group's inbox is live in this process."""
-    if lookup_inbox(name) is None:
+    when the named group's inbox is live in this process AND the peer is
+    admitted by the membership fence (an evicted member re-dialing at its
+    stale generation gets a clean refusal, never a silent stream into a
+    fence)."""
+    inbox = lookup_inbox(name)
+    if inbox is None:
         return (f"no collective group {name!r} registered in this process "
                 "(peer attached before/after the group's lifetime)")
+    if not inbox.admits(src_eid, generation):
+        return (f"executor {src_eid} is not a member of collective group "
+                f"{name!r} at generation {generation} (evicted or fenced); "
+                "attach refused")
     return None
 
 
 def serve_attached(conn: socket.socket, name: str, src_rank: int,
-                   generation: int) -> None:
+                   generation: int, src_eid: int = -1) -> None:
     """Receive loop for one attached peer connection: route chunk frames
     into the group's inbox until the peer closes (or the group goes away).
     Runs on the dataserver's per-connection thread — the reason sends from
     a compute thread can never deadlock against a peer that is also mid-
-    send: every node's inbound wire is drained unconditionally."""
+    send: every node's inbound wire is drained unconditionally.  The
+    connection is registered against the sender's eid so a membership
+    change (eviction) can hard-sever it from our side."""
     from tensorflowonspark_tpu.dataserver import _recv_frame
 
     inbox = lookup_inbox(name)
     if inbox is None:
         return
+    inbox.note_attach(src_eid, conn)
     rx_bytes = telemetry.counter("collective.rx_bytes")
     rx_frames = telemetry.counter("collective.rx_frames")
     last_gen = generation
@@ -212,6 +285,7 @@ def serve_attached(conn: socket.socket, name: str, src_rank: int,
         # poison only OURS, never the successor's
         current = lookup_inbox(name)
         if current is inbox:
+            inbox.forget_attach(src_eid, conn)
             inbox.fail_peer(src_rank, last_gen)
 
 
@@ -225,7 +299,10 @@ class PeerTransport:
     ``configure``/``close`` run on the map_fun thread — the small lock only
     guards the shared maps, never any blocking I/O."""
 
-    def __init__(self, name: str, authkey: bytes, timeout: float):
+    def __init__(self, name: str, authkey: bytes, timeout: float,
+                 detect: bool = True):
+        from tensorflowonspark_tpu.utils.envtune import env_float
+
         self.name = name
         self.authkey = authkey
         self.timeout = timeout
@@ -234,6 +311,19 @@ class PeerTransport:
         self._members: list[dict] = []
         self._generation = 0
         self._rank = -1
+        self._eid = -1
+        # Straggler detection (gray-failure tolerance): rolling EMA of
+        # COMPLETED recv waits is the "typical contribution time" baseline;
+        # a wait running TOS_COLLECTIVE_SUSPECT_FACTOR past it is a
+        # persistent outlier worth reporting.  Relative by construction:
+        # uniform slowness (a degraded network hitting everyone) raises the
+        # baseline with the waits and never flags anyone.
+        self.detect = bool(detect)
+        self._suspect_factor = max(1.5, env_float(
+            "TOS_COLLECTIVE_SUSPECT_FACTOR", 8.0))
+        self._suspect_cb = None
+        self._wait_ema: float | None = None
+        self._reported: dict[tuple[int, int], float] = {}
         self.inbox = CollectiveInbox(name)
         register_inbox(name, self.inbox)
 
@@ -252,17 +342,36 @@ class PeerTransport:
         with self._lock:
             return len(self._members)
 
+    def set_suspect_callback(self, cb) -> None:
+        """Install the group's suspicion reporter: ``cb(src_rank,
+        wait_secs) -> bool`` files a vote with the coordinator and returns
+        True when a member of the CURRENT formation was evicted at quorum —
+        the cue for a blocked recv to abort now instead of riding out the
+        full collective timeout."""
+        self._suspect_cb = cb
+
+    def member_eids(self) -> list[int]:
+        """Executor ids of the current formation, rank-ordered."""
+        with self._lock:
+            return [int(m["eid"]) for m in self._members]
+
     def configure(self, generation: int, rank: int, members: list[dict]) -> None:
         """Adopt a completed formation: new generation, rank, and peer
         endpoints.  Every cached outbound channel is dropped — a surviving
         socket may point at a dead predecessor's port, and the new
-        generation must start from fresh dials."""
+        generation must start from fresh dials.  The inbox adopts the live
+        membership too, severing any attach connection from an evicted
+        (non-member) peer — the hard half of the peer-plane fence."""
         with self._lock:
             self._generation = int(generation)
             self._rank = int(rank)
             self._members = [dict(m) for m in members]
+            if 0 <= rank < len(members):
+                self._eid = int(members[rank]["eid"])
+            self._reported.clear()
         self.drop_connections()
-        self.inbox.advance_generation(int(generation))
+        self.inbox.advance_generation(
+            int(generation), [int(m["eid"]) for m in members])
 
     def drop_connections(self) -> None:
         """Close every outbound channel (abort path + reconfigure): closing
@@ -305,7 +414,7 @@ class PeerTransport:
 
         host, port = self._endpoint(dst)
         with self._lock:
-            gen, rank = self._generation, self._rank
+            gen, rank, eid = self._generation, self._rank, self._eid
         sock = connect_with_backoff((host, port), timeout=self.timeout,
                                     attempts=3)
         try:
@@ -316,7 +425,10 @@ class PeerTransport:
             if not hmac_handshake_client(sock, self.authkey):
                 raise CollectiveAborted(
                     f"peer rank {dst} rejected the cluster authkey")
-            _send(sock, ("collective_attach", self.name, rank, gen), wire=2)
+            # the attach carries our eid so the receiver can key the
+            # connection for membership severing (gray-failure fencing)
+            _send(sock, ("collective_attach", self.name, rank, gen, eid),
+                  wire=2)
             reply = _recv(sock)
             if not (isinstance(reply, tuple) and reply and reply[0] == "ok"):
                 raise CollectiveAborted(
@@ -340,9 +452,13 @@ class PeerTransport:
         usually a numpy array — it travels as a protocol-5 out-of-band
         buffer, scatter-gathered straight from its own memory — but any
         picklable object works (broadcast headers)."""
+        from tensorflowonspark_tpu import faultinject
         from tensorflowonspark_tpu.dataserver import frame_parts
         from tensorflowonspark_tpu.utils.net import sendmsg_all
 
+        # chaos seam: `slow_peer:ms=M` injects degraded-NIC latency on
+        # every peer-plane send in the armed process
+        faultinject.peer_send_delay()
         with self._lock:
             sock = self._conns.get(dst)
             gen, rank = self._generation, self._rank
@@ -365,11 +481,91 @@ class PeerTransport:
             int(getattr(payload, "nbytes", 0)))
         telemetry.counter("collective.tx_frames").inc()
 
+    def _note_wait(self, wait: float) -> None:
+        """Fold one COMPLETED recv wait into the rolling baseline."""
+        with self._lock:
+            if self._wait_ema is None:
+                self._wait_ema = wait
+            else:
+                self._wait_ema += 0.2 * (wait - self._wait_ema)
+
+    def suspect_threshold(self, budget: float) -> float:
+        """Wait (seconds) past which a peer is a persistent outlier worth
+        reporting: SUSPECT_FACTOR x the rolling typical wait, floored at
+        0.5s (below that is scheduler noise, not a gray failure) and capped
+        at a quarter of the recv budget (detection must always beat the
+        round timeout, or eviction never improves on thrashing).  With NO
+        baseline yet (the group's first round: dials, attaches, cold TCP
+        windows) the floor doubles — connection setup must not read as a
+        stall."""
+        with self._lock:
+            ema = self._wait_ema
+        floor = 0.5 if ema is not None else 1.0
+        base = max(ema if ema is not None else 0.0, 1e-3)
+        return min(max(self._suspect_factor * base, floor),
+                   max(floor, budget / 4.0))
+
+    def _maybe_report(self, generation: int, src: int, waited: float) -> bool:
+        """Rate-limited suspicion report (at most one per second per
+        (generation, src)); True when the callback says the current round
+        is doomed (a member was evicted at quorum)."""
+        cb = self._suspect_cb
+        if cb is None:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._reported.get((generation, src), 0.0) < 1.0:
+                return False
+            self._reported[(generation, src)] = now
+        try:
+            return bool(cb(src, waited))
+        except Exception:  # noqa: BLE001 - reporting must never poison a healthy round
+            logger.debug("suspicion report for rank %d failed", src,
+                         exc_info=True)
+            return False
+
     def recv(self, src: int, seq: int, tag, timeout: float | None = None):
+        """Blocking receive with straggler detection: the wait is sliced so
+        that once it runs ``suspect_threshold`` past the rolling typical
+        wait, a suspicion vote is filed with the coordinator (abort
+        attribution: the vote names the peer we are waiting ON) — and if
+        quorum evicts a member of this formation, the round aborts NOW
+        instead of riding out the remaining collective timeout."""
         with self._lock:
             gen = self._generation
-        return self.inbox.recv(gen, src, seq, tag,
-                               self.timeout if timeout is None else timeout)
+        budget = self.timeout if timeout is None else timeout
+        if not self.detect or self._suspect_cb is None:
+            wait_t0 = time.monotonic()
+            payload = self.inbox.recv(gen, src, seq, tag, budget)
+            if self.detect:
+                self._note_wait(time.monotonic() - wait_t0)
+            return payload
+        deadline = time.monotonic() + budget
+        threshold = self.suspect_threshold(budget)
+        t0 = time.monotonic()
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # final abort attribution: the timeout itself is the
+                # strongest suspicion signal — file it before poisoning
+                self._maybe_report(gen, src, time.monotonic() - t0)
+                raise CollectiveTimeout(
+                    f"timed out after {budget:.0f}s waiting for chunk "
+                    f"{tag!r} from rank {src} (generation {gen})")
+            slice_ = min(remaining, max(0.05, threshold / 2.0))
+            try:
+                payload = self.inbox.recv(gen, src, seq, tag, slice_)
+            except CollectiveTimeout:
+                waited = time.monotonic() - t0
+                if waited >= threshold and self._maybe_report(gen, src,
+                                                              waited):
+                    raise CollectiveAborted(
+                        f"peer rank {src} evicted at quorum after waiting "
+                        f"{waited:.1f}s (generation {gen}); round "
+                        "poisoned") from None
+                continue
+            self._note_wait(time.monotonic() - t0)
+            return payload
 
     def close(self) -> None:
         # unregister FIRST so a racing attach can't hand a connection to a

@@ -56,6 +56,18 @@ _STATS_HISTORY_CAP = 240
 # stats thread folds the full control-plane state into <journal>.snap and
 # truncates the tail, so crash recovery replays O(delta) records.
 _JOURNAL_SNAPSHOT_EVERY = 256
+# Straggler-suspicion vote freshness: votes older than this never count
+# toward an eviction quorum (a live straggler's accusers re-file every
+# second; a one-off hiccup's vote must age out, not lie in ambush).
+_SUSPECT_VOTE_TTL = 30.0
+# Eviction confirmation hold: quorum against a suspect must SURVIVE this
+# window before the eviction fires.  Uniform slowness makes everyone blame
+# their upstream at once, but the votes arrive one by one — a partial
+# blame cycle is indistinguishable from a genuine chain until the would-be
+# suspect's own vote lands and dissolves it.  A true straggler files
+# nothing (it is busy being wedged), so it only costs ~this much detection
+# latency; accusers re-file every second, which re-evaluates the hold.
+_EVICT_CONFIRM_SECS = 2.0
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -69,6 +81,16 @@ class CoordinatorRestarted(RuntimeError):
     the retry at their own abstraction level (a collective group re-forms
     at the next generation barrier; idempotent ops are retried
     transparently and never raise this)."""
+
+
+class CoordinatorFenced(RuntimeError):
+    """This client's (executor_id, incarnation) is FENCED: the slot was
+    declared dead and re-fenced, or — the gray-failure case — the process
+    was EVICTED from its collective group at quorum and parked in
+    probation.  A RuntimeError subclass so existing retry loops keep
+    working; typed so a collective ``form`` can tell "ride out probation,
+    readmission will hand me a fresh incarnation" apart from transient
+    rendezvous churn."""
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -265,6 +287,23 @@ class CoordinatorServer:
         # restores which replicas were serving — statz/run-report evidence
         # operators read after the fact.
         self._serving: dict[str, list[int]] = {}
+        # Gray-failure tolerance (ISSUE 15): suspicion votes per collective
+        # group ({group: {suspect_eid: {voter_eid: mono_time}}}), the live
+        # membership each group's last `form` produced, members EVICTED at
+        # quorum and parked in probation ({eid: {"group", "probation_until",
+        # "last_ping", "incarnation"}}), slots whose evicted process was
+        # readmitted and must relearn its bumped incarnation over its next
+        # round-trips ({eid: incarnation}), the event feed the cluster
+        # monitor drains (park/unpark the supervisor, rebalance the
+        # ledger), and the run-lifetime eviction log for stats/tests.
+        self._suspicions: dict[str, dict[int, dict[int, float]]] = {}
+        self._evict_pending: dict[tuple[str, int], float] = {}
+        self._collective: dict[str, dict] = {}
+        self._evicted: dict[int, dict] = {}
+        self._readmit_pending: dict[int, int] = {}
+        self._collective_events: list[dict] = []
+        self._eviction_log: list[dict] = []
+        self._readmits_total = 0
         # Write-ahead journal (ISSUE 13): every control-plane mutation
         # appends an fsync'd record (under self._lock, so record order IS
         # mutation order); crash() + restore() replay it into this same
@@ -470,6 +509,12 @@ class CoordinatorServer:
             "manifest": dict(self._manifest),
             "errors": [dict(e) for e in self._errors],
             "serving": {k: list(v) for k, v in self._serving.items()},
+            # gray-failure state: who sits in probation (probation clocks
+            # are monotonic and restart conservatively at restore) and who
+            # is mid-relearn of a readmitted incarnation
+            "evicted": {str(e): d["group"] for e, d in self._evicted.items()},
+            "readmit_pending": {str(e): i
+                                for e, i in self._readmit_pending.items()},
             "complete": self._complete.is_set(),
             # registered slots with no liveness clock (declared dead, or
             # cleanly deregistered): restore must NOT re-seed them, or a
@@ -541,6 +586,12 @@ class CoordinatorServer:
             self._manifest = {}
             self._serving = {}
             self._rdv = {}
+            self._suspicions = {}
+            self._evict_pending = {}
+            self._collective = {}
+            self._evicted = {}
+            self._readmit_pending = {}
+            self._collective_events = []
         for cb in list(self._crash_listeners):
             try:
                 cb()
@@ -576,10 +627,29 @@ class CoordinatorServer:
             self._errors = [dict(e) for e in snap.get("errors") or []]
             self._serving = {k: [int(x) for x in v] for k, v in
                              (snap.get("serving") or {}).items()}
+            self._evicted = {}
+            for e, group in (snap.get("evicted") or {}).items():
+                self._restore_evicted_locked(int(e), str(group))
+            self._readmit_pending = {
+                int(e): int(i)
+                for e, i in (snap.get("readmit_pending") or {}).items()}
             complete = bool(snap.get("complete", False))
             untracked = {int(x) for x in snap.get("untracked") or []}
             for rec in records:
                 complete = self._apply_record_locked(rec, complete, untracked)
+            # Re-emit eviction/readmission events for the restored state:
+            # the crash wiped any not-yet-drained event (and the monitor
+            # may have missed the originals entirely if the crash raced its
+            # tick), so the cluster-side side effects — supervisor
+            # park/unpark, ledger rebalance, train re-attach — are replayed
+            # from scratch.  All of them are idempotent by construction.
+            for eid, d in self._evicted.items():
+                self._collective_events.append(
+                    {"kind": "evicted", "eid": eid, "group": d["group"]})
+            for eid in self._readmit_pending:
+                if eid not in self._evicted:
+                    self._collective_events.append(
+                        {"kind": "readmitted", "eid": eid, "group": ""})
             self._epoch += 1
             epoch = self._epoch
             if complete or (self._nodes and len(self._nodes) >= self.expected):
@@ -628,6 +698,7 @@ class CoordinatorServer:
             meta = dict(d["meta"])
             eid = int(meta["executor_id"])
             untracked.discard(eid)
+            self._evicted.pop(eid, None)
             slot = next((m for m in self._nodes
                          if m["executor_id"] == eid), None)
             if d.get("replace") and slot is not None:
@@ -642,6 +713,10 @@ class CoordinatorServer:
                 untracked.add(int(eid))
                 self._incarnations[int(eid)] = \
                     self._incarnations.get(int(eid), 0) + 1
+                # death wins over any probation/relearn record before it
+                # (mirrors mark_dead and the silent-probation reap)
+                self._evicted.pop(int(eid), None)
+                self._readmit_pending.pop(int(eid), None)
         elif kind == "deregister":
             untracked.add(int(d["eid"]))
         elif kind == "open_slots":
@@ -667,12 +742,40 @@ class CoordinatorServer:
         elif kind == "serving":
             self._serving[str(d.get("gateway"))] = \
                 [int(x) for x in d.get("replicas") or []]
+        elif kind == "evict":
+            eid = int(d["eid"])
+            untracked.add(eid)
+            self._incarnations[eid] = self._incarnations.get(eid, 0) + 1
+            self._restore_evicted_locked(eid, str(d.get("group") or "train"))
+            self._readmit_pending.pop(eid, None)
+        elif kind == "readmit":
+            eid = int(d["eid"])
+            untracked.discard(eid)
+            self._evicted.pop(eid, None)
+            self._readmit_pending[eid] = self._incarnations.get(eid, 0)
         # rdv_open / rdv_close / rdv_abort / form / ledger: flight-record
         # riders — the generations they describe died with the crash and
         # re-form client-side at the next generation barrier.  The epoch
         # itself persists exclusively through snapshots (restore() writes
         # one immediately after every bump), never through tail records.
         return complete
+
+    def _restore_evicted_locked(self, executor_id: int, group: str) -> None:
+        """The ONE probation-entry constructor (live eviction AND crash
+        replay — the two must never diverge on shape or clock semantics):
+        the window starts NOW relative to this process's monotonic clock.
+        For a journal replay that is conservative — the original eviction's
+        clock died with the crash, and a failover never shortens a
+        straggler's bench time — and the readmission health probe works
+        unchanged either way."""
+        from tensorflowonspark_tpu.utils.envtune import env_float
+
+        probation = max(0.0, env_float("TOS_COLLECTIVE_PROBATION_SECS", 30.0))
+        now = time.monotonic()
+        self._evicted[executor_id] = {
+            "group": group, "at": now, "last_ping": now,
+            "probation_until": now + probation,
+            "incarnation": self._incarnations.get(executor_id, 0)}
 
     def _retire_replay_locked(self, executor_id: int) -> None:
         self._incarnations[executor_id] = \
@@ -696,6 +799,222 @@ class CoordinatorServer:
     def serving_replicas(self) -> dict[str, list[int]]:
         with self._lock:
             return {k: list(v) for k, v in self._serving.items()}
+
+    # -- gray-failure eviction (straggler suspicion -> quorum -> probation) ---
+
+    @staticmethod
+    def _resolve_blame_locked(reports: dict[int, dict[int, float]],
+                              blamed: int) -> int | None:
+        """Transitive blame resolution: a blamed member that is ITSELF
+        filing suspicion against its own upstream is a pipeline victim
+        (in a ring, everyone downstream of the straggler stalls in order),
+        so the blame shifts upstream until it lands on a member that is
+        blamed but not blaming.  A CYCLE — the walk revisiting a member —
+        is the uniform-slowness signature (everyone waiting on everyone)
+        and resolves to None: no clear straggler, nobody evicted.  The
+        walk follows blame edges without excluding visited nodes (that
+        exclusion would make every cycle terminate on an arbitrary member
+        and falsely convict it; the revisit IS the terminator)."""
+        seen: set[int] = set()
+        cur = blamed
+        while cur not in seen:
+            seen.add(cur)
+            upstream = [b for b, voters in reports.items() if cur in voters]
+            if not upstream:
+                return cur
+            cur = min(upstream)  # deterministic walk on fan-out
+        return None  # cycle: no clear straggler
+
+    def _op_suspect(self, msg: dict) -> dict:
+        """Record one survivor's suspicion vote and evaluate the quorum.
+
+        Votes are keyed (group, suspect, voter) — refiling refreshes, never
+        double-counts — cleared wholesale at each formation (a new
+        generation is a fresh slate).  Zombie voters never reach here
+        (standard incarnation fencing), so an evicted member cannot vote
+        its survivors out in revenge."""
+        from tensorflowonspark_tpu.utils.envtune import env_int
+
+        group = str(msg.get("group") or "train")
+        suspect = int(msg["suspect"])
+        voter = int(msg.get("executor_id", -1))
+        wait = float(msg.get("wait_secs") or 0.0)
+        now = time.monotonic()
+        evicted_now: int | None = None
+        with self._lock:
+            info = self._collective.get(group)
+            members = list(info["members"]) if info else []
+            reports = self._suspicions.setdefault(group, {})
+            if suspect != voter and voter >= 0:
+                reports.setdefault(suspect, {})[voter] = now
+            # vote freshness: a live straggler's accusers renew every
+            # second; a cold-start hiccup's lone vote must not linger and
+            # later combine with an unrelated incident into a bogus quorum
+            cutoff = now - _SUSPECT_VOTE_TTL
+            for blamed in list(reports):
+                voters_at = reports[blamed]
+                for v in [v for v, t in voters_at.items() if t < cutoff]:
+                    del voters_at[v]
+                if not voters_at:
+                    del reports[blamed]
+            # resolve every report's transitive blame, then tally distinct
+            # voters per FINAL suspect (a transferred vote still counts —
+            # in a ring only the straggler's direct neighbor observes it
+            # first-hand, so quorum must credit downstream victims too)
+            tally: dict[int, set[int]] = {}
+            for blamed, voters in reports.items():
+                final = self._resolve_blame_locked(reports, blamed)
+                if final is None:
+                    continue
+                tally.setdefault(final, set()).update(voters)
+            survivors = max(1, len(members) - 1)
+            quorum = env_int("TOS_COLLECTIVE_EVICT_QUORUM", 0) \
+                or (survivors // 2 + 1)
+            min_world = max(1, env_int("TOS_COLLECTIVE_MIN_WORLD", 1))
+            votes = 0
+            confirmed: set[tuple[str, int]] = set()
+            for target in sorted(tally):
+                voters = {v for v in tally[target] if v != target}
+                if not (target in members and target not in self._evicted
+                        and len(voters) >= quorum
+                        and len(members) - 1 >= min_world):
+                    continue
+                key = (group, target)
+                confirmed.add(key)
+                pending_since = self._evict_pending.setdefault(key, now)
+                if now - pending_since < _EVICT_CONFIRM_SECS:
+                    # hold: a partial blame cycle (uniform slowness, votes
+                    # still in flight) must get the chance to dissolve
+                    continue
+                del self._evict_pending[key]
+                self._evict_locked(target, group, wait)
+                evicted_now = target
+                votes = len(voters)
+                break
+            # any hold whose quorum evaporated (the cycle completed, votes
+            # aged out, membership changed) is dropped, not left armed
+            for key in [k for k in self._evict_pending
+                        if k[0] == group and k not in confirmed]:
+                del self._evict_pending[key]
+            evicted = sorted(e for e, d in self._evicted.items()
+                             if d["group"] == group)
+        if evicted_now is not None:
+            telemetry.counter("collective.evictions_total").inc()
+            telemetry.gauge("coordinator.live_slots").set(
+                len(self._last_seen))
+            ttrace.event("evicted", executor=evicted_now, group=group,
+                         votes=votes, wait_secs=round(wait, 2))
+            logger.error(
+                "executor %d EVICTED from collective group %r at quorum "
+                "(%d survivor vote(s); gray failure — slow or wedged, not "
+                "dead); parked in probation, group continues at world %d",
+                evicted_now, group, votes, len(members) - 1)
+            # survivors may be blocked in a formation sized for the full
+            # world — abort so they re-enter at the degraded count
+            self._abort_rendezvous()
+        return {"ok": True, "evicted": evicted, "quorum": quorum}
+
+    def _evict_locked(self, executor_id: int, group: str,
+                      wait_secs: float) -> None:
+        """State half of a quorum eviction (caller holds ``_lock``): fence
+        the incarnation, stop liveness tracking (the monitor must not ALSO
+        declare a death — the process is alive, just benched), start the
+        probation clock, and shrink the group's live membership."""
+        self._last_seen.pop(executor_id, None)
+        self._incarnations[executor_id] = \
+            self._incarnations.get(executor_id, 0) + 1
+        self._stats_history.pop(str(executor_id), None)
+        self._readmit_pending.pop(executor_id, None)
+        self._restore_evicted_locked(executor_id, group)
+        info = self._collective.get(group)
+        if info and executor_id in info["members"]:
+            info["members"].remove(executor_id)
+        sus = self._suspicions.get(group)
+        if sus:
+            sus.pop(executor_id, None)
+            for voters in sus.values():
+                voters.pop(executor_id, None)
+        self._collective_events.append(
+            {"kind": "evicted", "eid": executor_id, "group": group})
+        self._eviction_log.append(
+            {"eid": executor_id, "group": group,
+             "wait_secs": round(wait_secs, 2)})
+        self._log("evict", eid=executor_id, group=group)
+
+    def _maybe_readmit_locked(self, executor_id: int) -> int | None:
+        """Probation check on a fenced heartbeat from an evicted process:
+        once the probation window expired — and the heartbeat arriving IS
+        the health probe: the process is alive and can reach us — readmit
+        the slot.  Returns the incarnation the process must adopt, or None
+        while probation holds."""
+        ent = self._evicted.get(executor_id)
+        if ent is None:
+            return None
+        now = time.monotonic()
+        ent["last_ping"] = now
+        if now < ent["probation_until"]:
+            return None
+        del self._evicted[executor_id]
+        inc = self._incarnations.get(executor_id, 0)
+        # every stale client of the readmitted process relearns the bumped
+        # incarnation on its next served round-trip (see _dispatch_inner)
+        self._readmit_pending[executor_id] = inc
+        self._last_seen[executor_id] = now
+        self._readmits_total += 1
+        self._collective_events.append(
+            {"kind": "readmitted", "eid": executor_id,
+             "group": ent["group"]})
+        self._log("readmit", eid=executor_id)
+        return inc
+
+    def reap_silent_probation(self, heartbeat_timeout: float) -> list[int]:
+        """Probation entries whose process went HEARTBEAT-SILENT: an
+        evicted member is untracked by normal liveness (eviction popped its
+        clock so the monitor never double-declares), so if it genuinely
+        dies while benched nothing else would ever notice — the world would
+        stay degraded forever with a ghost probation entry.  Called from
+        the cluster monitor's tick: silent entries convert into ordinary
+        deaths (fenced again, probation record dropped, journaled) and are
+        returned for the caller to hand to the supervisor — which unparks
+        and respawns, exactly as if the death had never hidden behind the
+        eviction."""
+        newly: list[int] = []
+        now = time.monotonic()
+        with self._lock:
+            for eid in [e for e, d in self._evicted.items()
+                        if now - d["last_ping"] > heartbeat_timeout]:
+                del self._evicted[eid]
+                self._incarnations[eid] = self._incarnations.get(eid, 0) + 1
+                self._readmit_pending.pop(eid, None)
+                self._collective_events.append(
+                    {"kind": "probation_death", "eid": eid})
+                self._log("dead", eids=[eid])
+                newly.append(eid)
+        for eid in newly:
+            telemetry.counter("coordinator.deaths_total").inc()
+            ttrace.event("death", executor=eid)
+            logger.error("evicted node %d went silent in probation "
+                         "(>%.0fs without a heartbeat); its bench death is "
+                         "now an ordinary death", eid, heartbeat_timeout)
+        return newly
+
+    def evicted_members(self) -> dict[int, dict]:
+        """Slots currently evicted to probation (diagnostic + tests)."""
+        with self._lock:
+            return {e: dict(d) for e, d in self._evicted.items()}
+
+    def evictions(self) -> list[dict]:
+        """Run-lifetime eviction log (survives readmission)."""
+        with self._lock:
+            return [dict(x) for x in self._eviction_log]
+
+    def drain_collective_events(self) -> list[dict]:
+        """One-shot drain of eviction/readmission events — the cluster
+        monitor's feed for parking/unparking the supervisor and
+        rebalancing the evicted slot's ledger work."""
+        with self._lock:
+            events, self._collective_events = self._collective_events, []
+        return events
 
     # -- driver-side queries -------------------------------------------------
 
@@ -762,6 +1081,10 @@ class CoordinatorServer:
                     continue
                 newly.append(i)
                 self._incarnations[i] = self._incarnations.get(i, 0) + 1
+                # a readmitted-then-dead slot forfeits its relearn window
+                # (and any straggler probation record): death wins
+                self._readmit_pending.pop(i, None)
+                self._evicted.pop(i, None)
                 # a restarted slot's counters restart at 0: its rolling-stats
                 # stream must restart with them, or the first post-restart
                 # window computes negative rates against the old cumulatives
@@ -936,6 +1259,8 @@ class CoordinatorServer:
             self._incarnations.get(executor_id, 0) + 1
         self._draining.discard(executor_id)
         self._retired.add(executor_id)
+        self._readmit_pending.pop(executor_id, None)
+        self._evicted.pop(executor_id, None)
         self._stats_history.pop(str(executor_id), None)
         for m in self._nodes:
             if m["executor_id"] == executor_id:
@@ -1123,6 +1448,27 @@ class CoordinatorServer:
         if ingest_ids:
             out["ingest"] = self._ingest_stats_block(out["streams"],
                                                      ingest_ids)
+        with self._lock:
+            if self._collective or self._evicted or self._eviction_log:
+                # the gray-failure block: which formations stand, who sits
+                # in probation (and for how much longer), live suspicion
+                # votes, and the run-lifetime eviction/readmit tallies —
+                # the evidence operators read when a sync run degrades
+                out["collective"] = {
+                    "groups": {g: {"members": list(i["members"]),
+                                   "generation": i["generation"]}
+                               for g, i in self._collective.items()},
+                    "evicted": {str(e): {
+                        "group": d["group"],
+                        "probation_secs_left": round(max(
+                            0.0, d["probation_until"] - now), 1)}
+                        for e, d in self._evicted.items()},
+                    "suspicion_votes": {
+                        g: {str(s): sorted(v) for s, v in sus.items()}
+                        for g, sus in self._suspicions.items() if sus},
+                    "evictions_total": len(self._eviction_log),
+                    "readmits_total": self._readmits_total,
+                }
         return out
 
     def _ingest_stats_block(self, streams: dict, ingest_ids: list[int]) -> dict:
@@ -1250,6 +1596,104 @@ class CoordinatorServer:
         resp.setdefault("epoch", self._epoch)
         return resp
 
+    def _readmit_relearn(self, msg: dict) -> int | None:
+        """The post-eviction identity hand-back: once a parked process is
+        READMITTED, its slot's incarnation was bumped past every client the
+        process already holds (main, heartbeat, consensus, collective) —
+        and there is no replacement process to race, because eviction parks
+        instead of respawning.  So a stale-incarnation message from a
+        readmit-pending slot is served NORMALLY and its reply carries
+        ``readmit_incarnation``: every client self-heals on its next
+        round-trip.  Returns the incarnation to advertise, or None (no
+        relearn in progress / the sender already caught up)."""
+        eid, inc = msg.get("executor_id"), msg.get("incarnation")
+        if eid is None or inc is None:
+            return None
+        with self._lock:
+            pend = self._readmit_pending.get(int(eid))
+            if pend is None or int(inc) != pend - 1:
+                # No relearn in progress, this client already caught up, or
+                # the sender is an OLDER incarnation than the one evicted —
+                # i.e. a pre-eviction zombie from an ordinary death/respawn
+                # cycle, which must stay fenced (only the readmitted
+                # process's clients hold exactly pend-1).  The window stays
+                # OPEN for those clients (main/consensus/collective relearn
+                # at their own pace) and closes only when the slot dies,
+                # retires, or re-evicts — safe, because eviction never
+                # respawns: the readmitted process is the slot's only owner.
+                return None
+            return pend
+
+    def _fenced_reply(self, op: str, msg: dict) -> dict:
+        """Replies for a fenced (stale-incarnation) sender.
+
+        Two populations land here: a dead slot's zombie predecessor
+        (classic fencing — heartbeats answer stop so it winds down) and an
+        EVICTED-but-alive gray member parked in probation.  The evicted
+        process must NOT stop: its heartbeats are the probation health
+        probe, and the first one past the probation window readmits the
+        slot (handing back a fresh incarnation for every stale client to
+        adopt)."""
+        eid = int(msg.get("executor_id", -1))
+        sender_inc = int(msg.get("incarnation", -1))
+        with self._lock:
+            ent = self._evicted.get(eid)
+            # The probation probe is ONLY the evicted process itself: its
+            # clients hold exactly the pre-eviction incarnation.  An even
+            # older zombie (a predecessor from an ordinary death/respawn
+            # before the eviction) must neither refresh the probe clock —
+            # it would mask a real probation death from the reaper — nor,
+            # at expiry, be handed the slot: it gets the classic fenced
+            # stop reply below.
+            if ent is not None and sender_inc != ent["incarnation"] - 1:
+                ent = None
+            if ent is not None and op == "heartbeat":
+                inc = self._maybe_readmit_locked(eid)
+                if inc is not None:
+                    readmitted = True
+                else:
+                    readmitted = False
+                    remaining = max(
+                        0.0, ent["probation_until"] - time.monotonic())
+                # the benched process is the slot's legitimate owner: its
+                # telemetry/trace riders merge as usual — the probation
+                # window is exactly the stretch a postmortem needs (the
+                # classic fenced-ZOMBIE drop below stays a drop)
+                if msg.get("metrics"):
+                    self._merge_metrics_locked(eid, msg["metrics"])
+                if msg.get("trace"):
+                    self._merge_trace_locked(str(eid), msg["trace"])
+            evicted = ent is not None
+        if evicted and op == "heartbeat":
+            if readmitted:
+                telemetry.counter("collective.readmits_total").inc()
+                ttrace.event("readmitted", executor=eid)
+                logger.warning(
+                    "executor %d passed its probation health probe; "
+                    "READMITTED at incarnation %d — the group grows back "
+                    "at its next generation barrier", eid, inc)
+                return {"ok": True, "stop": self._stop_flag.is_set(),
+                        "evicted": False, "readmit_incarnation": inc,
+                        "now": time.monotonic()}
+            return {"ok": True, "stop": self._stop_flag.is_set(),
+                    "evicted": True,
+                    "probation_secs": round(remaining, 3),
+                    "now": time.monotonic()}
+        if op == "heartbeat":
+            return {"ok": True, "stop": True, "fenced": True}
+        if op in ("barrier", "reduce"):
+            if evicted:
+                return {"ok": False, "fenced": True, "evicted": True,
+                        "error": (f"executor {eid} was evicted from "
+                                  f"collective group {ent['group']!r} (gray "
+                                  "failure) and is parked in probation; "
+                                  "rejoin follows readmission")}
+            return {"ok": False, "fenced": True,
+                    "error": (f"stale incarnation {msg.get('incarnation')} for "
+                              f"executor {msg.get('executor_id')}: slot was "
+                              "declared dead and re-fenced")}
+        return {"ok": True, "fenced": True}
+
     def _dispatch_inner(self, msg: dict) -> dict:
         op = msg.get("op")
         try:
@@ -1266,104 +1710,115 @@ class CoordinatorServer:
                         "error": (f"request from coordinator epoch {ep} fenced "
                                   f"(current epoch {self._epoch}): the control "
                                   "plane restarted; re-sync and retry")}
-            if op != "register" and self._is_fenced(msg):
+            relearn = self._readmit_relearn(msg)
+            if op != "register" and relearn is None and self._is_fenced(msg):
                 # TF-Replicator-style generation fencing: the zombie must
-                # never influence live state.  Heartbeats answer stop=True so
-                # the stale process deliberately winds itself down; barriers/
-                # reduces fail loudly (joining a live generation would wedge
-                # or corrupt it); reports (error/deregister/update_meta) are
-                # swallowed — the supervisor already owns this slot's fate.
-                if op == "heartbeat":
-                    return {"ok": True, "stop": True, "fenced": True}
-                if op in ("barrier", "reduce"):
-                    return {"ok": False, "fenced": True,
-                            "error": (f"stale incarnation {msg.get('incarnation')} for "
-                                      f"executor {msg.get('executor_id')}: slot was "
-                                      "declared dead and re-fenced")}
-                return {"ok": True, "fenced": True}
-            if op == "register":
-                return self._op_register(msg)
-            if op == "query":
-                return {"ok": True, "complete": self._complete.is_set(), "count": len(self._nodes)}
-            if op == "cluster_info":
-                if not self._complete.is_set():
-                    return {"ok": False, "error": "cluster incomplete"}
-                return {"ok": True, "nodes": self.cluster_info()}
-            if op == "barrier":
-                msg = dict(msg, kind="all", value=True)
-                return self._op_reduce(msg)
-            if op == "reduce":
-                return self._op_reduce(msg)
-            if op == "update_meta":
-                with self._lock:
-                    for m in self._nodes:
-                        if m["executor_id"] == msg["executor_id"]:
-                            m.update(msg.get("patch") or {})
-                return {"ok": True}
-            if op == "heartbeat":
-                with self._lock:
-                    # a deregistered (cleanly exited) node sends no further
-                    # beats; never resurrect one from a late in-flight ping —
-                    # and never let such a ping's metric delta overwrite the
-                    # FINAL snapshot the deregister already merged (the
-                    # heartbeat thread races teardown on its own connection)
-                    if msg["executor_id"] in self._last_seen:
-                        self._last_seen[msg["executor_id"]] = time.monotonic()
-                        if msg.get("metrics"):
-                            self._merge_metrics_locked(int(msg["executor_id"]),
-                                                       msg["metrics"])
-                    # trace deltas are append-only (spans/events, never a
-                    # snapshot overwrite), so keep one even from a ping that
-                    # raced deregister — it holds spans the final delta
-                    # doesn't, and the node-side restore path never sees a
-                    # reply that said ok.  Zombies never reach here (fenced).
-                    if msg.get("trace"):
-                        self._merge_trace_locked(str(msg["executor_id"]),
-                                                 msg["trace"])
-                # "now" is this process's monotonic clock at reply build —
-                # the client's RTT-midpoint clock-offset estimate hangs off
-                # it (trace timeline merging, trace_export.py)
-                return {"ok": True, "stop": self._stop_flag.is_set(),
-                        "now": time.monotonic()}
-            if op == "metrics":
-                return {"ok": True, "snapshot": self.cluster_metrics()}
-            if op == "statz":
-                return {"ok": True, "stats": self.cluster_stats(
-                    float(msg.get("window") or 10.0))}
-            if op == "manifest":
-                with self._lock:
-                    return {"ok": True, "manifest": dict(self._manifest)}
-            if op == "deregister":
-                # node exiting deliberately (map_fun done, or error already
-                # reported): stop liveness tracking so the driver's dead-node
-                # monitor never flags a clean exit as a death.  The final
-                # metrics snapshot rides along — work done after the last
-                # heartbeat must still reach the cluster view.
-                with self._lock:
-                    if self._last_seen.pop(msg["executor_id"], None) is not None:
-                        self._log("deregister",
-                                  eid=int(msg["executor_id"]))
-                    if msg.get("metrics"):
-                        self._merge_metrics_locked(int(msg["executor_id"]),
-                                                   msg["metrics"])
-                    if msg.get("trace"):
-                        self._merge_trace_locked(str(msg["executor_id"]),
-                                                 msg["trace"])
-                return {"ok": True}
-            if op == "error":
-                with self._lock:
-                    self._errors.append({"executor_id": msg.get("executor_id"), "traceback": msg.get("traceback", "")})
-                logger.error("node %s reported error:\n%s", msg.get("executor_id"), msg.get("traceback", ""))
-                return {"ok": True}
-            if op == "stop":
-                self._stop_flag.set()
-                return {"ok": True}
-            if op == "bye":
-                return {"ok": True}
-            return {"ok": False, "error": f"unknown op {op!r}"}
+                # never influence live state — with the one carve-out of a
+                # readmitted-from-eviction process relearning its identity
+                # (relearn above; there is no replacement to race).
+                return self._fenced_reply(op, msg)
+            resp = self._serve_op(op, msg)
+            if relearn is not None and resp.get("ok"):
+                resp["readmit_incarnation"] = relearn
+            return resp
         except Exception as e:  # keep the server alive on handler bugs
             logger.exception("coordinator op %s failed", op)
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _serve_op(self, op: str, msg: dict) -> dict:
+        if op == "register":
+            return self._op_register(msg)
+        if op == "query":
+            return {"ok": True, "complete": self._complete.is_set(), "count": len(self._nodes)}
+        if op == "cluster_info":
+            if not self._complete.is_set():
+                return {"ok": False, "error": "cluster incomplete"}
+            return {"ok": True, "nodes": self.cluster_info()}
+        if op == "barrier":
+            msg = dict(msg, kind="all", value=True)
+            return self._op_reduce(msg)
+        if op == "reduce":
+            return self._op_reduce(msg)
+        if op == "update_meta":
+            with self._lock:
+                for m in self._nodes:
+                    if m["executor_id"] == msg["executor_id"]:
+                        m.update(msg.get("patch") or {})
+            return {"ok": True}
+        if op == "heartbeat":
+            with self._lock:
+                # a deregistered (cleanly exited) node sends no further
+                # beats; never resurrect one from a late in-flight ping —
+                # and never let such a ping's metric delta overwrite the
+                # FINAL snapshot the deregister already merged (the
+                # heartbeat thread races teardown on its own connection)
+                if msg["executor_id"] in self._last_seen:
+                    self._last_seen[msg["executor_id"]] = time.monotonic()
+                    if msg.get("metrics"):
+                        self._merge_metrics_locked(int(msg["executor_id"]),
+                                                   msg["metrics"])
+                # trace deltas are append-only (spans/events, never a
+                # snapshot overwrite), so keep one even from a ping that
+                # raced deregister — it holds spans the final delta
+                # doesn't, and the node-side restore path never sees a
+                # reply that said ok.  Zombies never reach here (fenced).
+                if msg.get("trace"):
+                    self._merge_trace_locked(str(msg["executor_id"]),
+                                             msg["trace"])
+            # "now" is this process's monotonic clock at reply build —
+            # the client's RTT-midpoint clock-offset estimate hangs off
+            # it (trace timeline merging, trace_export.py)
+            return {"ok": True, "stop": self._stop_flag.is_set(),
+                    "now": time.monotonic()}
+        if op == "metrics":
+            return {"ok": True, "snapshot": self.cluster_metrics()}
+        if op == "statz":
+            return {"ok": True, "stats": self.cluster_stats(
+                float(msg.get("window") or 10.0))}
+        if op == "manifest":
+            with self._lock:
+                return {"ok": True, "manifest": dict(self._manifest)}
+        if op == "deregister":
+            # node exiting deliberately (map_fun done, or error already
+            # reported): stop liveness tracking so the driver's dead-node
+            # monitor never flags a clean exit as a death.  The final
+            # metrics snapshot rides along — work done after the last
+            # heartbeat must still reach the cluster view.
+            with self._lock:
+                if self._last_seen.pop(msg["executor_id"], None) is not None:
+                    self._log("deregister",
+                              eid=int(msg["executor_id"]))
+                if msg.get("metrics"):
+                    self._merge_metrics_locked(int(msg["executor_id"]),
+                                               msg["metrics"])
+                if msg.get("trace"):
+                    self._merge_trace_locked(str(msg["executor_id"]),
+                                             msg["trace"])
+            return {"ok": True}
+        if op == "error":
+            with self._lock:
+                self._errors.append({"executor_id": msg.get("executor_id"), "traceback": msg.get("traceback", "")})
+            logger.error("node %s reported error:\n%s", msg.get("executor_id"), msg.get("traceback", ""))
+            return {"ok": True}
+        if op == "suspect":
+            return self._op_suspect(msg)
+        if op == "cworld":
+            # effective-world adjudication for a degraded formation:
+            # nominal world minus the group's members parked in probation
+            group = str(msg.get("group") or "train")
+            nominal = int(msg.get("world") or 0)
+            with self._lock:
+                ev = sorted(e for e, d in self._evicted.items()
+                            if d["group"] == group)
+            return {"ok": True, "evicted": ev,
+                    "effective": (max(1, nominal - len(ev))
+                                  if nominal else None)}
+        if op == "stop":
+            self._stop_flag.set()
+            return {"ok": True}
+        if op == "bye":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
 
     def _op_register(self, msg: dict) -> dict:
         meta = dict(msg.get("meta") or {})
@@ -1411,6 +1866,14 @@ class CoordinatorServer:
                 return {"ok": False, "error": (f"executor slot {executor_id} "
                                                "was retired (scale-in); "
                                                "refusing replacement")}
+            if executor_id in self._evicted:
+                # an evicted slot's PROCESS IS ALIVE (parked in probation);
+                # registering a replacement would split-brain the slot —
+                # eviction parks, it never respawns
+                return {"ok": False, "error": (f"executor slot {executor_id} "
+                                               "is evicted to probation (its "
+                                               "process is alive); refusing "
+                                               "replacement")}
             if executor_id in self._last_seen:
                 return {"ok": False, "error": (f"executor {executor_id} is still "
                                                "liveness-tracked; refusing replacement")}
@@ -1474,11 +1937,24 @@ class CoordinatorServer:
                         # collective membership is control-plane state worth
                         # keeping: the postmortem (and a future cold-start
                         # resume) can see who stood at which generation
-                        self._log("form", name=name,
-                                  members=[int(m["eid"])
-                                           for m in rdv.result["members"]],
+                        member_eids = [int(m["eid"])
+                                       for m in rdv.result["members"]]
+                        self._log("form", name=name, members=member_eids,
                                   generation=rdv.result["generation"],
                                   step=rdv.result["step"])
+                        # live membership for the gray-failure machinery:
+                        # suspicion quorums count against THIS formation,
+                        # and a fresh generation is a fresh slate of votes
+                        gname = name
+                        if gname.startswith("cg.") and gname.endswith(".form"):
+                            gname = gname[3:-5]
+                        self._collective[gname] = {
+                            "members": member_eids,
+                            "generation": int(rdv.result["generation"])}
+                        self._suspicions.pop(gname, None)
+                        for key in [k for k in self._evict_pending
+                                    if k[0] == gname]:
+                            del self._evict_pending[key]
                 rdv.cond.notify_all()
             else:
                 deadline = time.monotonic() + timeout
@@ -1541,6 +2017,9 @@ class CoordinatorClient:
         # last coordinator epoch observed on a reply (None until the first
         # round-trip); a bump is flight-recorded once per change
         self.epoch: int | None = None
+        # True when the last heartbeat reply said this slot is EVICTED to
+        # probation (gray failure) — the node's heartbeat loop parks on it
+        self.last_evicted = False
         # latest clock estimate from a heartbeat round-trip (driver-mono =
         # local-mono + offset, midpoint method); the node's heartbeat loop
         # feeds the best of these to the tracer for timeline merging
@@ -1586,6 +2065,12 @@ class CoordinatorClient:
         a replacement."""
         self._executor_id = int(executor_id)
         self._incarnation = int(incarnation)
+
+    @property
+    def incarnation(self) -> int:
+        """The incarnation this client currently stamps — bumped in place
+        when a readmission reply hands back ``readmit_incarnation``."""
+        return self._incarnation
 
     def _stamp(self, msg: dict) -> dict:
         if self._executor_id is not None and msg.get("op") != "register":
@@ -1638,12 +2123,25 @@ class CoordinatorClient:
                 _send_msg(self._sock, msg)
                 resp = _recv_msg(self._sock)
         self._note_epoch(resp)
+        ri = resp.get("readmit_incarnation")
+        if ri is not None and self._executor_id is not None \
+                and int(ri) > self._incarnation:
+            # the slot was evicted (gray failure) and READMITTED: the
+            # coordinator hands back the bumped incarnation on served
+            # replies so every stale client of the process self-heals
+            logger.warning("executor %d readmitted after eviction; this "
+                           "client adopts incarnation %d",
+                           self._executor_id, int(ri))
+            self._incarnation = int(ri)
         return resp
 
     def _check(self, resp: dict) -> dict:
         if not resp.get("ok"):
             if resp.get("stale_epoch"):
                 raise CoordinatorRestarted(
+                    f"coordinator error: {resp.get('error')}")
+            if resp.get("fenced"):
+                raise CoordinatorFenced(
                     f"coordinator error: {resp.get('error')}")
             raise RuntimeError(f"coordinator error: {resp.get('error')}")
         return resp
@@ -1722,6 +2220,25 @@ class CoordinatorClient:
         return self.reduce(name, dict(member), kind="form", timeout=timeout,
                            count=count)
 
+    def suspect(self, group: str, suspect_eid: int,
+                wait_secs: float) -> dict:
+        """File one straggler-suspicion vote against ``suspect_eid`` (the
+        peer this node has been waiting on).  Idempotent per voter —
+        refiling refreshes the vote — so it retries transparently; the
+        reply carries the group's current ``evicted`` list, which doubles
+        as the "is my round doomed" poll."""
+        return self._check(self._call(
+            {"op": "suspect", "group": str(group),
+             "suspect": int(suspect_eid),
+             "wait_secs": float(wait_secs)}, retry=True))
+
+    def collective_world(self, group: str, world: int) -> dict:
+        """Effective-world adjudication for a degraded formation:
+        ``{"effective": nominal - evicted, "evicted": [...]}``."""
+        return self._check(self._call(
+            {"op": "cworld", "group": str(group), "world": int(world)},
+            retry=True))
+
     def next_collective_name(self, prefix: str) -> str:
         """Locally-generated unique name; callers must use it SPMD-consistently."""
         self._gen += 1
@@ -1753,6 +2270,7 @@ class CoordinatorClient:
         if server_now is not None:
             self.last_rtt = t1 - t0
             self.last_clock_offset = float(server_now) - (t0 + t1) / 2.0
+        self.last_evicted = bool(resp.get("evicted"))
         return bool(resp["stop"])
 
     def metrics(self) -> dict:

@@ -333,12 +333,19 @@ class DataServer:
                         transport as _ctransport,
                     )
 
-                    err = _ctransport.attach_error(str(msg[1]))
+                    # frame: (op, group, src_rank, generation[, src_eid]) —
+                    # the eid rider keys the connection for membership
+                    # severing (gray-failure hard fencing); older 4-tuple
+                    # senders key as -1 (never severed by membership)
+                    src_eid = int(msg[4]) if len(msg) > 4 else -1
+                    err = _ctransport.attach_error(str(msg[1]), src_eid,
+                                                   int(msg[3]))
                     _send(conn, ("ok",) if err is None else ("err", err),
                           wire=2 if was_vec else 1)
                     if err is None:
                         _ctransport.serve_attached(conn, str(msg[1]),
-                                                   int(msg[2]), int(msg[3]))
+                                                   int(msg[2]), int(msg[3]),
+                                                   src_eid)
                     return
                 try:
                     reply = self._handle(msg)

@@ -38,6 +38,18 @@ deterministically while its peers stay healthy):
   (zombie phase) and its first data-carrying op of the window severs the
   connection; even windows are healthy (re-admit phase).  Wall-clock
   driven by design — the action models link flap, not a counted event.
+- ``stall_collective:after_rounds=N[,secs=S]`` — the GRAY failure: inside
+  its N-th collective all-reduce (same seam as ``kill_collective``) the
+  process goes silent for S seconds (default 300) and then resumes —
+  alive the whole time, heartbeating, just not moving gradient bytes.
+  Models a long GC pause / stolen core / wedged NIC queue: the case
+  straggler detection + quorum eviction exist for (survivors must evict
+  and continue at W-1 instead of thrashing on the collective timeout).
+- ``slow_peer:ms=M`` — degraded-NIC gray fault: injects M milliseconds of
+  latency on every collective PEER-PLANE send (``collective/transport``)
+  in the armed process, for as long as it lives.  Armed on every node it
+  models uniform slowness — the false-positive case eviction must never
+  fire on; armed on one it models the persistent outlier.
 
 Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
 ``name:key=value,key=value`` —
@@ -46,6 +58,8 @@ Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
     TOS_FAULTINJECT="drop_heartbeats:count=8;sever:after_data_ops=2"
     TOS_FAULTINJECT="kill_coordinator:after_ops=40"
     TOS_FAULTINJECT="delay_net:ms=5;flap:period=2"
+    TOS_FAULTINJECT="stall_collective:after_rounds=3,secs=8,executor=1"
+    TOS_FAULTINJECT="slow_peer:ms=25"
 
 Common keys: ``executor=E`` fires only on that executor id (ids are assigned
 at registration, so per-node targeting usually rides ``per_node_env``
@@ -78,16 +92,18 @@ class FaultInjected(Exception):
 
 class _Action:
     __slots__ = ("name", "threshold", "executor", "incarnation", "role",
-                 "fired", "count", "hb_cycle", "sever_cycle")
+                 "extra", "fired", "count", "hb_cycle", "sever_cycle")
 
     def __init__(self, name: str, threshold: int,
                  executor: int | None, incarnation: int | None,
-                 role: str | None = None):
+                 role: str | None = None, extra: dict | None = None):
         self.name = name
         self.threshold = threshold
         self.executor = executor
         self.incarnation = incarnation
         self.role = role
+        # secondary action parameters (e.g. stall_collective's `secs=`)
+        self.extra = extra or {}
         self.fired = False
         self.count = 0
         # flap bookkeeping: last down-window index counted / severed, so
@@ -110,19 +126,27 @@ class FaultPlan:
              # crash the control-plane server on its Nth dispatched op
              # (coordinator._dispatch) — the journaled-recovery chaos clock
              "kill_coordinator": "after_ops",
+             # gray failure: go silent for `secs` inside the Nth all-reduce
+             # (same seam as kill_collective) — alive, heartbeating, not
+             # moving bytes; straggler detection must evict, not thrash
+             "stall_collective": "after_rounds",
              # continuous network degradation: the "threshold" is the
              # parameter (ms of latency / seconds of flap period), not a
              # count — see _CONTINUOUS
              "delay_net": "ms",
+             "slow_peer": "ms",
              "flap": "period"}
+    # optional secondary keys per action (int-valued)
+    _EXTRA_KEYS = {"stall_collective": frozenset({"secs"})}
     # one-shot actions fire once when the counter REACHES the threshold;
     # windowed actions fire on EVERY call until the threshold is spent
     # (drop_heartbeats swallows the first K pings — one dropped ping would
     # never outlast the driver's dead-node timeout)
     _WINDOWED = frozenset({"drop_heartbeats"})
     # continuous actions never "fire and disarm": they degrade the process
-    # for its whole life (delay_net) or on a periodic schedule (flap)
-    _CONTINUOUS = frozenset({"delay_net", "flap"})
+    # for its whole life (delay_net / slow_peer) or on a periodic schedule
+    # (flap)
+    _CONTINUOUS = frozenset({"delay_net", "slow_peer", "flap"})
 
     def __init__(self, actions: list[_Action]):
         self._lock = threading.Lock()
@@ -142,7 +166,9 @@ class FaultPlan:
             name, _, rest = chunk.partition(":")
             name = name.strip()
             if name not in cls._KEYS:
-                raise ValueError(f"unknown fault action {name!r} in {spec!r}")
+                raise ValueError(
+                    f"unknown fault action {name!r} in {spec!r} "
+                    f"(known actions: {', '.join(sorted(cls._KEYS))})")
             kv = {}
             role: str | None = None
             for pair in filter(None, (p.strip() for p in rest.split(","))):
@@ -155,14 +181,20 @@ class FaultPlan:
                     # registration-order and so cannot ride per_node_env
                     role = v.strip()
                     continue
-                kv[k] = int(v)
+                # secondary parameters (e.g. stall secs) may be fractional;
+                # thresholds/filters stay integral
+                kv[k] = (float(v)
+                         if k in cls._EXTRA_KEYS.get(name, frozenset())
+                         else int(v))
             threshold = kv.pop(cls._KEYS[name], 1)
             executor = kv.pop("executor", None)
             incarnation = kv.pop("incarnation", None)
+            extra = {k: kv.pop(k) for k in list(kv)
+                     if k in cls._EXTRA_KEYS.get(name, frozenset())}
             if kv:
                 raise ValueError(f"unknown keys {sorted(kv)} for fault {name!r}")
             actions.append(_Action(name, threshold, executor, incarnation,
-                                   role))
+                                   role, extra))
         return cls(actions)
 
     def set_identity(self, executor_id: int, incarnation: int = 0,
@@ -172,8 +204,9 @@ class FaultPlan:
             self._incarnation = incarnation
             self._role = role
 
-    def _tick(self, name: str) -> bool:
-        """Advance the named action's counter; True when it fires this call."""
+    def _tick(self, name: str) -> "_Action | None":
+        """Advance the named action's counter; the fired action (truthy)
+        when it fires this call, else None."""
         with self._lock:
             for a in self._actions:
                 if a.name != name or a.fired:
@@ -189,12 +222,12 @@ class FaultPlan:
                     if a.count >= a.threshold:
                         a.fired = True
                     self._count_injection(name)
-                    return True
+                    return a
                 if a.count >= a.threshold:
                     a.fired = True
                     self._count_injection(name)
-                    return True
-        return False
+                    return a
+        return None
 
     def _armed(self, name: str) -> _Action | None:
         """The identity-matched action of a CONTINUOUS kind, else None."""
@@ -211,11 +244,13 @@ class FaultPlan:
                 return a
         return None
 
-    def delay_ms(self) -> int:
-        """Injected per-send latency (``delay_net:ms=M``), 0 when unarmed.
-        Metered once at first delay (flight event) and per delayed send
-        (``faultinject.delayed_sends`` counter) — the caller sleeps."""
-        a = self._armed("delay_net")
+    def delay_ms(self, name: str = "delay_net") -> int:
+        """Injected per-send latency (``delay_net:ms=M`` on the control/data
+        planes, ``slow_peer:ms=M`` on the collective peer plane), 0 when
+        unarmed.  Metered once at first delay (flight event) and per
+        delayed send (``faultinject.delayed_sends`` counter) — the caller
+        sleeps."""
+        a = self._armed(name)
         if a is None:
             return 0
         with self._lock:
@@ -223,8 +258,16 @@ class FaultPlan:
             a.fired = True
             a.count += 1
         if first:
-            self._count_injection("delay_net")
+            self._count_injection(name)
         return a.threshold
+
+    def stall_secs(self) -> float:
+        """Seconds the ``stall_collective`` gray fault wants this process to
+        go silent for, when its round counter fires NOW; 0.0 otherwise."""
+        a = self._tick("stall_collective")
+        if a is None:
+            return 0.0
+        return float(a.extra.get("secs", 300))
 
     def _flap_window(self, a: _Action) -> tuple[int, bool]:
         """(window index since arming, is this a DOWN window)."""
@@ -347,9 +390,35 @@ def collective_round() -> None:
     chunk exchange (``collective/ops.py``); ``kill_collective`` SIGKILLs
     here, dying with partial chunks on the wire and peers blocked in the
     same round (the poisoned-round case incarnation fencing + the
-    generation barrier exist for)."""
-    if _PLAN is not None and _PLAN._tick("kill_collective"):
+    generation barrier exist for).  ``stall_collective`` fires at the same
+    seam but SLEEPS instead of dying — the gray failure: partial chunks in
+    flight, heartbeats still flowing, peers blocked on a member that is
+    slow, not dead (the case quorum eviction exists for)."""
+    if _PLAN is None:
+        return
+    if _PLAN._tick("kill_collective"):
         _sigkill_self()
+    secs = _PLAN.stall_secs()
+    if secs > 0:
+        logger.warning("fault injection: stalling collective for %.1fs "
+                       "(gray failure; pid %d)", secs, os.getpid())
+        time.sleep(secs)
+        logger.warning("fault injection: collective stall over (pid %d)",
+                       os.getpid())
+
+
+def peer_send_delay() -> None:
+    """Hook: about to ship a chunk frame on the collective peer plane
+    (``collective/transport.PeerTransport.send``); ``slow_peer:ms=M``
+    sleeps M milliseconds here — the degraded-NIC gray fault."""
+    if _PLAN is None:
+        return
+    ms = _PLAN.delay_ms("slow_peer")
+    if ms:
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.counter("faultinject.delayed_sends").inc()
+        time.sleep(ms / 1000.0)
 
 
 def drop_heartbeat() -> bool:
@@ -357,7 +426,7 @@ def drop_heartbeat() -> bool:
     ``drop_heartbeats`` action, or a ``flap`` DOWN window)."""
     if _PLAN is None:
         return False
-    return _PLAN._tick("drop_heartbeats") or _PLAN.flap_down()
+    return bool(_PLAN._tick("drop_heartbeats")) or _PLAN.flap_down()
 
 
 def data_op() -> None:
@@ -377,7 +446,7 @@ def coordinator_op() -> bool:
     """Hook: a control-plane request reached the coordinator's dispatcher;
     True = ``kill_coordinator`` fires now (the server crash()es itself —
     the journaled-recovery path owns what happens next)."""
-    return _PLAN is not None and _PLAN._tick("kill_coordinator")
+    return _PLAN is not None and bool(_PLAN._tick("kill_coordinator"))
 
 
 def net_delay() -> None:

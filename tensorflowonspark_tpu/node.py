@@ -494,6 +494,7 @@ def node_main(config: NodeConfig) -> int:
     stop_requested = threading.Event()
 
     def _heartbeat_loop() -> None:
+        nonlocal incarnation
         from tensorflowonspark_tpu import telemetry
         from tensorflowonspark_tpu.telemetry import trace as ttrace
         from tensorflowonspark_tpu.utils.envtune import env_float
@@ -564,16 +565,43 @@ def node_main(config: NodeConfig) -> int:
                                       hb_client.last_rtt)
                 ever_ok = True
                 last_ok = time.monotonic()
-                if parked:
+                if hb_client.incarnation != incarnation:
+                    # READMITTED after a gray-failure eviction: the
+                    # coordinator handed this channel the slot's bumped
+                    # incarnation.  Propagate to the process's other
+                    # identity holders NOW — the main client may sit idle
+                    # for minutes (its next round-trip would also relearn),
+                    # and faultinject keys per-incarnation arming off it.
+                    incarnation = hb_client.incarnation
+                    client.set_identity(executor_id, incarnation)
+                    faultinject.set_identity(executor_id, incarnation,
+                                             role=ident["job_name"])
+                    logger.warning("node %d adopted incarnation %d after "
+                                   "readmission", executor_id, incarnation)
+                if hb_client.last_evicted:
+                    # EVICTED from the collective group at quorum (gray
+                    # failure): park — no new ledger work while benched;
+                    # keep heartbeating (the pings ARE the probation
+                    # health probe the coordinator readmits on).
+                    if not parked:
+                        parked = True
+                        queues.compare_and_set("state", "running", "parked")
+                        ttrace.event("evicted_parked", executor=executor_id)
+                        logger.warning(
+                            "node %d evicted from its collective group "
+                            "(quorum of straggler-suspicion votes); parked "
+                            "in probation until readmitted", executor_id)
+                elif parked:
                     # re-admitted: the coordinator (possibly a journal-
-                    # recovered one at a bumped epoch) answered our ping
-                    # without fencing us — resume taking ledger work.
+                    # recovered one at a bumped epoch, possibly after an
+                    # eviction probation) answered our ping without fencing
+                    # or benching us — resume taking ledger work.
                     # compare_and_set: a feed that TERMINATED while parked
                     # keeps its fast-drain state (stop beats park).
                     parked = False
                     queues.compare_and_set("state", "parked", "running")
                     ttrace.event("readmit", executor=executor_id)
-                    logger.warning("coordinator reachable again; node %d "
+                    logger.warning("coordinator re-admitted node %d; "
                                    "unparked", executor_id)
             except Exception:
                 # the delta that rode the failed ping may be lost: drop the

@@ -189,6 +189,12 @@ class Supervisor:
         # retirement, never recovery: no respawn, no restart-budget charge,
         # no elastic.restarts_total increment.
         self._retired: set[int] = set()
+        # Slots EVICTED from a collective group at quorum (gray failure):
+        # the process is alive, benched in probation — respawning a
+        # replacement into the slot would split-brain it, so recovery is
+        # declined until the coordinator readmits (unpark) or the slot is
+        # definitively dead after probation.
+        self._parked: set[int] = set()
         self._threads: list[threading.Thread] = []
 
     # -- status (consumed by the partition ledger's recovery waits) ----------
@@ -210,6 +216,35 @@ class Supervisor:
     def retired(self, executor_id: int) -> bool:
         with self._lock:
             return executor_id in self._retired
+
+    def park(self, executor_id: int) -> None:
+        """Collective eviction (gray failure): bench the slot.  Its process
+        is ALIVE — slow or wedged, not dead — so ``handle_death`` declines
+        to respawn while parked: a replacement would split-brain the slot
+        against the still-running original.  The coordinator's readmission
+        (probation health probe passed) unparks it."""
+        with self._lock:
+            if executor_id in self._parked:
+                return
+            self._parked.add(executor_id)
+        telemetry.counter("elastic.parked_total").inc()
+        logger.warning("executor %d parked in probation (collective "
+                       "eviction); supervised restart declined while its "
+                       "process is alive", executor_id)
+
+    def unpark(self, executor_id: int) -> None:
+        """The evicted process passed its probation health probe and was
+        readmitted — normal death recovery applies again."""
+        with self._lock:
+            if executor_id not in self._parked:
+                return
+            self._parked.discard(executor_id)
+        logger.info("executor %d unparked (readmitted after probation)",
+                    executor_id)
+
+    def parked(self, executor_id: int) -> bool:
+        with self._lock:
+            return executor_id in self._parked
 
     def restart_count(self, executor_id: int) -> int:
         with self._lock:
@@ -234,6 +269,15 @@ class Supervisor:
                 # no respawn, no budget charge, no restart counted
                 logger.info("executor %d died while retiring; not recovering "
                             "(intentional scale-in)", executor_id)
+                return
+            if executor_id in self._parked:
+                # evicted to probation: the original process is (or was
+                # moments ago) alive — respawning would split-brain the
+                # slot; readmission or an explicit unpark re-enables
+                # recovery
+                logger.warning("executor %d declared dead while parked in "
+                               "probation; not respawning (eviction parks, "
+                               "it never refills the slot)", executor_id)
                 return
             if executor_id in self._inflight or executor_id in self._permanent:
                 return

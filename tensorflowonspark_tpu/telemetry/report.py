@@ -183,6 +183,30 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
             "cache_evictions": counters.get("ingest.cache_evictions", 0),
             "forward_errors": counters.get("ingest.forward_errors", 0),
         }
+    collective = None
+    if counters.get("collective.rounds_total") \
+            or counters.get("collective.formations_total") \
+            or counters.get("collective.evictions_total"):
+        # the sync-training postmortem block: how many rounds/formations
+        # ran, how often the group aborted and re-formed, and the gray-
+        # failure tallies (suspicion votes filed, quorum evictions,
+        # probation readmissions) — the first place to look when a sync
+        # run degraded to W-1 or thrashed
+        collective = {
+            "rounds_total": counters.get("collective.rounds_total", 0),
+            "formations_total": counters.get(
+                "collective.formations_total", 0),
+            "reforms_total": counters.get("collective.reforms_total", 0),
+            "aborts_total": counters.get("collective.aborts_total", 0),
+            "suspects_total": counters.get("collective.suspects_total", 0),
+            "evictions_total": counters.get(
+                "collective.evictions_total", 0),
+            "readmits_total": counters.get("collective.readmits_total", 0),
+            "form_p50_ms": _hist_ms(aggregated, "collective.form_secs",
+                                    "p50"),
+            "all_reduce_p50_ms": _hist_ms(
+                aggregated, "collective.all_reduce_secs", "p50"),
+        }
     report: dict[str, Any] = {
         "schema": "tos-run-report-v1",
         "written_at": time.time(),
@@ -201,6 +225,7 @@ def build_run_report(aggregated: dict, *, wall_secs: float | None = None,
         "rows_fed": counters.get("dataplane.rows_in"),
         "rows_consumed": counters.get("feed.rows_consumed"),
         "serving": serving,
+        "collective": collective,
         "restarts_total": counters.get("elastic.restarts_total", 0),
         "faults_injected": counters.get("faultinject.injected_total", 0),
         "counters": counters,

@@ -54,6 +54,24 @@ _ALL = (
          "pytree leaves pack into buckets of this many bytes (each bucket "
          "reduced as it fills, overlapping communication with host "
          "transfer), and ring transfers sub-chunk to it."),
+    Knob("TOS_COLLECTIVE_EVICT_QUORUM", "int", "0 (majority of survivors)",
+         "Gray-failure eviction: distinct survivor suspicion votes "
+         "(transitive blame resolved) required before the coordinator "
+         "evicts a straggling collective member; 0 derives a majority of "
+         "the formation's survivors."),
+    Knob("TOS_COLLECTIVE_MIN_WORLD", "int", "1",
+         "Gray-failure eviction floor: an eviction that would shrink a "
+         "collective group's effective world below this is refused (the "
+         "group then rides the collective timeout instead)."),
+    Knob("TOS_COLLECTIVE_PROBATION_SECS", "float", "30",
+         "How long an evicted (slow-but-alive) collective member stays "
+         "benched before its continuing heartbeats readmit it; the group "
+         "grows back at its next generation barrier."),
+    Knob("TOS_COLLECTIVE_SUSPECT_FACTOR", "float", "8",
+         "Straggler detection: a peer-plane receive wait running this many "
+         "times past the rolling typical wait files a suspicion vote "
+         "(floored at 0.5s, capped at a quarter of the collective timeout; "
+         "relative, so uniform slowness never flags anyone)."),
     Knob("TOS_COLLECTIVE_TIMEOUT", "float", "120",
          "Budget (seconds) for one cross-host collective exchange and for "
          "the group-formation rendezvous window; expiry poisons the round "

@@ -746,6 +746,96 @@ def sync_coordinator_chaos(args, ctx):
     group.close()
 
 
+def sync_gray_chaos(args, ctx):
+    """Fixed-step synchronous training under a GRAY failure (ISSUE 15):
+    one member stalls mid-all-reduce (``stall_collective`` — alive and
+    heartbeating, just silent on the peer plane).  Survivors must detect
+    the straggler, evict it at quorum, and continue at the DEGRADED world;
+    with ``grow_checks`` on they also poll for the evicted member's
+    readmission and re-form larger at a later generation barrier.
+
+    Results are written to ``gray_<eid>.txt`` FILES (json), not
+    ``update_meta``: an evicted-and-never-readmitted victim's control
+    plane is fenced, and its record must still reach the test."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.collective import CollectiveAborted
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    total = int(args["steps"])
+    group = ctx.collective_group(name=args.get("group", "gray"),
+                                 timeout=float(args.get("timeout", 30.0)))
+    step = group.form(resume_step=0)
+    optimizer = optax.sgd(0.125)
+    state = dplib.TrainState.create(
+        {"w": np.full((3, 1), 0.25, np.float32)}, optimizer)
+    state, step = group.sync_state(state, step)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        err = pred[:, 0] - batch["y"]
+        return jnp.mean(err * err), {}
+
+    train = dplib.make_train_step(loss_fn, optimizer,
+                                  cross_host_grad_fn=group.grad_fn())
+    reforms = 0
+    evicted_out = False
+    detect_secs = None      # stall onset -> CollectiveAborted (detection)
+    resume_secs = None      # stall onset -> first completed degraded step
+    t_stall_start = None
+    deadline = time.monotonic() + float(args.get("run_budget", 180.0))
+    while step < total and time.monotonic() < deadline:
+        if args.get("grow_checks") and group.check_grow(min_interval=0.5):
+            # a readmitted member stands ready: grow back at the next
+            # generation barrier and level it onto our step
+            group.reform(resume_step=step)
+            state, step = group.sync_state(state, step)
+            reforms += 1
+            continue
+        batch = chaos_batch(group.rank, step)
+        t_step = time.monotonic()
+        try:
+            state, _metrics = train(state, batch)  # victim stalls inside
+        except CollectiveAborted:
+            if t_stall_start is None:
+                t_stall_start = t_step
+                detect_secs = time.monotonic() - t_step
+            try:
+                group.reform(resume_step=step,
+                             timeout=float(args.get("reform_budget", 60.0)))
+            except CollectiveAborted:
+                # this node could not stand at any barrier within the
+                # budget: it is the evicted one (fenced through probation)
+                evicted_out = True
+                break
+            state, step = group.sync_state(state, step)
+            reforms += 1
+            continue
+        if resume_secs is None and t_stall_start is not None:
+            resume_secs = time.monotonic() - t_stall_start
+        step += 1
+        if args.get("step_delay"):
+            time.sleep(args["step_delay"])
+    record = {
+        "rank": group.rank, "steps": step, "reforms": reforms,
+        "generation": group.generation,
+        "effective_world": group.effective_world,
+        "evicted_out": evicted_out,
+        "detect_secs": detect_secs, "resume_secs": resume_secs,
+        "final_w": np.asarray(
+            jax.device_get(state.params["w"])).ravel().tolist(),
+    }
+    out = os.path.join(args["out_dir"], f"gray_{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        json.dump(record, f)
+    group.close()
+
+
 def sync_collective_chaos(args, ctx):
     """Fixed-step synchronous training on self-generated deterministic
     data, surviving a SIGKILL mid-all-reduce: survivors abort the poisoned

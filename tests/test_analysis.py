@@ -579,6 +579,50 @@ def test_journal_discipline_quiet_in_journal_py_and_on_non_journal_io():
     assert quiet == []
 
 
+# -- timeout discipline (collective/) -----------------------------------------
+
+
+def test_timeout_discipline_fires_on_unbounded_waits():
+    found = lint(
+        """
+        def run(self, fut, tp, seq, cond):
+            a = fut.result()
+            cond.wait()
+            b = tp.recv(0, seq, ("rs", 0, 0))
+            return a, b
+        """, f"{PKG}/collective/somemod.py", "timeout-discipline")
+    assert {f.anchor for f in found} == {"run@result", "run@wait",
+                                         "run@recv"}
+
+
+def test_timeout_discipline_fires_on_explicit_none_timeout():
+    found = lint(
+        """
+        def run(fut):
+            return fut.result(timeout=None)
+        """, f"{PKG}/collective/somemod.py", "timeout-discipline")
+    assert len(found) == 1 and "result" in found[0].message
+
+
+def test_timeout_discipline_quiet_on_bounded_waits_and_outside_collective():
+    src = """
+        def run(self, fut, tp, cond, gen, src, seq, tag, slice_):
+            a = fut.result(timeout=2.0 * self._timeout + 30.0)
+            cond.wait(min(0.5, remaining))
+            b = tp.recv(src, seq, tag, timeout=_left(deadline))
+            c = self.inbox.recv(gen, src, seq, tag, slice_)
+            return a, b, c
+        """
+    assert lint(src, f"{PKG}/collective/somemod.py",
+                "timeout-discipline") == []
+    # same unbounded calls OUTSIDE collective/ are out of scope
+    assert lint(
+        """
+        def run(fut):
+            return fut.result()
+        """, f"{PKG}/serving/router.py", "timeout-discipline") == []
+
+
 # -- silent-except discipline -------------------------------------------------
 
 

@@ -45,3 +45,23 @@ def test_bench_r14_control_plane_smoke():
         rec["restore_ms_median"]
     out = bench_collective.markdown_r14(result)
     assert "journal cost" in out and "recovery" in out
+
+
+def test_bench_r16_gray_failure_smoke():
+    """ISSUE 15 satellite: the eviction-latency cell must actually evict
+    (survivors finish every round at W-1 — asserted INSIDE the runner) and
+    the detect-overhead compare must produce both cells; tiny sizes."""
+    ev = bench_collective.bench_eviction_latency(
+        world=3, payload_mb=0.5, rounds=4, stall_round=2, stall_secs=6.0,
+        timeout=60.0)
+    assert ev["evicted"] == [1]
+    assert 0 < ev["stall_to_resume_secs"] < 30.0
+    assert ev["speedup_vs_timeout_x"] > 1.0
+    ov = bench_collective.bench_detect_compare(world=2, payload_mb=0.5,
+                                               repeats=3)
+    assert ov["detect_on"]["agg_mb_per_s"] > 0
+    assert ov["detect_off"]["agg_mb_per_s"] > 0
+    assert "overhead_pct" in ov
+    out = bench_collective.markdown_r16({"eviction": ev,
+                                         "detect_overhead": ov})
+    assert "degraded resume" in out and "bookkeeping overhead" in out

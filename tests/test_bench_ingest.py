@@ -5,6 +5,7 @@ here, not silently skew BENCH_r08's MB/s."""
 
 from __future__ import annotations
 
+import os
 import sys
 from pathlib import Path
 
@@ -26,6 +27,11 @@ def test_bench_mode_exact_counts(tmp_path, mode):
     assert result["mode"] == mode
 
 
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="quick-table streaming cell needs a second core: "
+                           "on a 1-core box the driver pump and the node "
+                           "consumer time-slice each other and the cell "
+                           "starves (fails on clean HEAD there too)")
 def test_bench_quick_table_shape(tmp_path):
     results = bench_ingest.bench(quick=True, fanout=(1,), repeats=1,
                                  data_dir=str(tmp_path / "shards"))

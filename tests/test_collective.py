@@ -1,14 +1,19 @@
-"""Cross-host collectives (ISSUE 12): transport/bucket units, exact
+"""Cross-host collectives (ISSUE 12/15): transport/bucket units, exact
 multi-node collective results, sync-training equivalence against a
-single-process run, and the chaos SIGKILL-mid-all-reduce rejoin.
+single-process run, the chaos SIGKILL-mid-all-reduce rejoin, and the
+gray-failure path — straggler suspicion, quorum eviction, degraded-world
+continuation, probation grow-back.
 
 The cluster tests are tier-1 by design, like the elastic suite: every
 recovery path of the generation-barrier rejoin runs on a deterministic
-fault schedule (``TOS_FAULTINJECT=kill_collective:...``), not in a soak.
+fault schedule (``TOS_FAULTINJECT=kill_collective:...`` /
+``stall_collective:...``), not in a soak.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 
@@ -20,8 +25,9 @@ from tensorflowonspark_tpu.collective.group import _plan_buckets
 from tensorflowonspark_tpu.collective.transport import (
     CollectiveAborted,
     CollectiveInbox,
+    CollectiveTimeout,
 )
-from tensorflowonspark_tpu.coordinator import _reduce
+from tensorflowonspark_tpu.coordinator import CoordinatorServer, _reduce
 from tensorflowonspark_tpu.launcher import SubprocessLauncher
 
 import mapfuns
@@ -317,3 +323,640 @@ def test_chaos_kill_mid_allreduce_rejoins_exact_steps(tmp_path, monkeypatch):
     # one supervised restart was spent, none left pending
     assert cluster.supervisor is not None
     assert cluster.supervisor.restart_count(1) == 1
+
+
+# -- gray failures: detection / quorum eviction units (ISSUE 15) --------------
+
+
+def _form_three(srv):
+    """Drive a 3-member `form` rendezvous straight through _dispatch."""
+    for i in range(3):
+        assert srv._dispatch({"op": "register",
+                              "meta": {"host": f"h{i}",
+                                       "data_port": 1000 + i}})["ok"]
+    results = {}
+
+    def join(eid):
+        results[eid] = srv._dispatch({
+            "op": "reduce", "name": "cg.train.form", "kind": "form",
+            "value": {"eid": eid, "host": f"h{eid}", "port": 1000 + eid,
+                      "gen": 1, "step": 0},
+            "count": 3, "executor_id": eid, "incarnation": 0,
+            "timeout": 10.0})
+
+    threads = [threading.Thread(target=join, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert all(r["ok"] for r in results.values()), results
+
+
+def test_suspect_quorum_evicts_with_transitive_blame(monkeypatch):
+    """The ring pipeline mis-attributes naively (everyone blames their own
+    left); the coordinator must resolve transitive blame onto the one
+    member that is blamed but not blaming, and evict it once quorum
+    SURVIVES the confirmation hold — fencing its incarnation and starting
+    the probation clock."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "600")
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.15)
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        # eid2 directly observes the straggler (its ring-left, eid1)
+        r = srv._dispatch({"op": "suspect", "group": "train", "suspect": 1,
+                           "wait_secs": 2.0, "executor_id": 2,
+                           "incarnation": 0})
+        assert r["ok"] and r["evicted"] == []
+        # eid0 blames ITS left (eid2) — a pipeline victim, exonerated by
+        # its own outstanding report; the vote transfers upstream to eid1.
+        # Quorum stands but the CONFIRMATION HOLD keeps the trigger back
+        # (the suspect still has the window to reveal a blame cycle).
+        r = srv._dispatch({"op": "suspect", "group": "train", "suspect": 2,
+                           "wait_secs": 2.0, "executor_id": 0,
+                           "incarnation": 0})
+        assert r["evicted"] == []
+        time.sleep(0.2)
+        # the straggler filed nothing during the hold: a re-filed vote
+        # (accusers re-file every second) confirms the eviction
+        r = srv._dispatch({"op": "suspect", "group": "train", "suspect": 1,
+                           "wait_secs": 3.0, "executor_id": 2,
+                           "incarnation": 0})
+        assert r["evicted"] == [1]
+        assert srv.registered_incarnation(1) == (1, False)  # fenced, benched
+        assert 1 in srv.evicted_members()
+        assert [e["eid"] for e in srv.evictions()] == [1]
+        # effective world shrank; nominal stays
+        assert srv._dispatch({"op": "cworld", "group": "train",
+                              "world": 3})["effective"] == 2
+        # the evicted process's heartbeat: NOT told to stop (it is the
+        # probation health probe), told it is evicted
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb["ok"] and hb["evicted"] and not hb["stop"]
+        # its form join is fenced with the evicted diagnosis
+        r = srv._dispatch({"op": "reduce", "name": "cg.train.form",
+                           "kind": "form", "value": {"eid": 1}, "count": 2,
+                           "executor_id": 1, "incarnation": 0,
+                           "timeout": 0.5})
+        assert not r["ok"] and r.get("fenced") and r.get("evicted")
+        # a replacement may NOT register into an evicted slot: the process
+        # is alive — eviction parks, it never respawns
+        r = srv._dispatch({"op": "register", "meta": {"host": "h9"},
+                           "replace": 1})
+        assert not r["ok"] and "probation" in r["error"]
+    finally:
+        srv.stop()
+
+
+def test_uniform_slowness_blame_cycle_never_evicts(monkeypatch):
+    """Everyone blaming their upstream (the uniform-slowness signature)
+    resolves to a cycle: no clear straggler, nobody evicted — even though
+    a PARTIAL cycle (votes land one at a time) transiently meets quorum,
+    the confirmation hold gives the last vote time to dissolve it."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.15)
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        for voter, blamed in ((0, 2), (2, 1), (1, 0)):
+            r = srv._dispatch({"op": "suspect", "group": "train",
+                               "suspect": blamed, "wait_secs": 1.0,
+                               "executor_id": voter, "incarnation": 0})
+            assert r["ok"] and r["evicted"] == [], r
+        # past the hold, with the full cycle on file: still nobody
+        time.sleep(0.2)
+        for voter, blamed in ((0, 2), (2, 1), (1, 0)):
+            r = srv._dispatch({"op": "suspect", "group": "train",
+                               "suspect": blamed, "wait_secs": 2.0,
+                               "executor_id": voter, "incarnation": 0})
+            assert r["ok"] and r["evicted"] == [], r
+        assert srv.evicted_members() == {}
+        assert srv.evictions() == []
+    finally:
+        srv.stop()
+
+
+def test_min_world_floor_refuses_eviction(monkeypatch):
+    """TOS_COLLECTIVE_MIN_WORLD: an eviction that would shrink the
+    effective world below the floor is refused — the group rides the
+    timeout instead of degrading past the operator's line."""
+    monkeypatch.setenv("TOS_COLLECTIVE_MIN_WORLD", "3")
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        for voter in (0, 2):
+            r = srv._dispatch({"op": "suspect", "group": "train",
+                               "suspect": 1, "wait_secs": 5.0,
+                               "executor_id": voter, "incarnation": 0})
+            assert r["evicted"] == []
+        assert srv.evicted_members() == {}
+    finally:
+        srv.stop()
+
+
+def test_probation_readmit_hands_back_incarnation(monkeypatch):
+    """Probation expiry + a live heartbeat = the health probe passing: the
+    slot readmits, the reply carries the bumped incarnation, and every
+    stale client of the process relearns it on its next served call."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "0.2")
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.0)
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        for voter, blamed in ((2, 1), (0, 1)):
+            srv._dispatch({"op": "suspect", "group": "train",
+                           "suspect": blamed, "wait_secs": 3.0,
+                           "executor_id": voter, "incarnation": 0})
+        assert 1 in srv.evicted_members()
+        time.sleep(0.25)
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb["ok"] and not hb.get("evicted")
+        assert hb.get("readmit_incarnation") == 1
+        assert srv.registered_incarnation(1) == (1, True)  # tracked again
+        # a DIFFERENT stale client of the same process (update_meta) is
+        # served AND handed the incarnation — no swallowed fence
+        r = srv._dispatch({"op": "update_meta", "executor_id": 1,
+                           "incarnation": 0, "patch": {"x": 1}})
+        assert r["ok"] and r.get("readmit_incarnation") == 1
+        # caught-up clients see no relearn rider
+        r = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                           "incarnation": 1})
+        assert r["ok"] and "readmit_incarnation" not in r
+        # effective world grew back
+        assert srv._dispatch({"op": "cworld", "group": "train",
+                              "world": 3})["effective"] == 3
+        events = srv.drain_collective_events()
+        assert [e["kind"] for e in events] == ["evicted", "readmitted"]
+    finally:
+        srv.stop()
+
+
+def test_inbox_membership_fence_and_attach_severing():
+    """Hard peer-plane fencing: frames at the current generation from a
+    rank outside the live world are dropped, attaches from non-members at
+    a stale generation are refused, and an evicted member's attach
+    connection is severed at reconfigure."""
+    import socket as socketlib
+
+    from tensorflowonspark_tpu.collective import transport as ctransport
+
+    box = CollectiveInbox("t")
+    box.advance_generation(2, member_eids=[0, 2])
+    # a frame at the CURRENT generation from a rank outside the live world
+    # (the highest-rank slot of the pre-eviction formation) is dropped;
+    # ranks 0..world-1 are recycled by the re-form, so the fence for THOSE
+    # is generation stamping + the eid-keyed attach gate below
+    box.deliver(2, 2, 1, "x", "zombie")
+    with pytest.raises(CollectiveTimeout):
+        box.recv(2, 2, 1, "x", timeout=0.05)
+    # live-rank frames still flow
+    box.deliver(2, 0, 1, "x", "fresh")
+    assert box.recv(2, 0, 1, "x", timeout=1.0) == "fresh"
+    # membership admission: non-member at stale gen refused, later gen ok
+    assert not box.admits(1, 2)
+    assert box.admits(1, 3)
+    assert box.admits(0, 2)
+    assert box.admits(-1, 0)  # legacy attach with no eid: never severed
+    # attach severing: an evicted peer's registered conn closes at the
+    # next advance_generation that excludes it
+    a, b = socketlib.socketpair()
+    try:
+        box.note_attach(1, a)
+        box.advance_generation(3, member_eids=[0, 2])
+        assert b.recv(1) == b""  # our end closed -> peer sees EOF
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+    # attach_error surfaces the refusal through the dataserver op (the box
+    # stands at generation 3 now: ahead-of-generation attaches pass — a
+    # readmitted member racing our reconfigure — at-or-behind are refused)
+    ctransport.register_inbox("fence-probe", box)
+    try:
+        assert ctransport.attach_error("fence-probe", 1, 4) is None
+        err = ctransport.attach_error("fence-probe", 1, 3)
+        assert err is not None and "not a member" in err
+    finally:
+        ctransport.unregister_inbox("fence-probe")
+
+
+def test_suspect_threshold_relative_to_baseline():
+    """Detection is RELATIVE: a warm baseline scales the threshold, so
+    uniform slowness (everyone ~equally slow) never crosses it, while a
+    true outlier (factor x the baseline) does.  Cold (no baseline) the
+    floor doubles so dial/attach setup never reads as a stall."""
+    from tensorflowonspark_tpu.collective.transport import PeerTransport
+
+    tp = PeerTransport("thresh-probe", b"k", timeout=120.0)
+    try:
+        assert tp.suspect_threshold(120.0) == pytest.approx(1.0)  # cold
+        for _ in range(50):
+            tp._note_wait(0.2)  # uniformly slow cluster: baseline ~0.2s
+        thr = tp.suspect_threshold(120.0)
+        assert 1.2 < thr <= 0.2 * 8 * 1.2  # scaled with the baseline
+        assert tp.suspect_threshold(4.0) == pytest.approx(1.0)  # budget cap
+    finally:
+        tp.close()
+
+
+def test_faultinject_gray_actions_parse():
+    from tensorflowonspark_tpu.faultinject import FaultPlan
+
+    plan = FaultPlan.parse("stall_collective:after_rounds=3,secs=7,"
+                           "executor=1;slow_peer:ms=25")
+    plan.set_identity(1, 0)
+    assert plan.stall_secs() == 0.0  # rounds 1, 2: armed but not yet fired
+    assert plan.stall_secs() == 0.0
+    assert plan.stall_secs() == 7.0  # round 3 fires with its secs payload
+    assert plan.stall_secs() == 0.0  # one-shot
+    assert plan.delay_ms("slow_peer") == 25  # continuous
+    assert plan.delay_ms("slow_peer") == 25
+    # default secs when omitted
+    plan2 = FaultPlan.parse("stall_collective:after_rounds=1")
+    plan2.set_identity(0, 0)
+    assert plan2.stall_secs() == 300.0
+    # unknown action error names the full vocabulary
+    with pytest.raises(ValueError, match="known actions: .*stall_collective"):
+        FaultPlan.parse("stall_forever:x=1")
+
+
+# -- chaos: gray stall -> suspicion -> quorum eviction -> W-1 continuation ----
+
+
+def _read_gray(out_dir, eid):
+    path = os.path.join(out_dir, f"gray_{eid}.txt")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _await_gray_files(out_dir, eids, deadline_secs):
+    deadline = time.monotonic() + deadline_secs
+    while time.monotonic() < deadline:
+        recs = {eid: _read_gray(out_dir, eid) for eid in eids}
+        if all(v is not None for v in recs.values()):
+            return recs
+        time.sleep(0.5)
+    return {eid: _read_gray(out_dir, eid) for eid in eids}
+
+
+def _gray_reference(total_steps, evict_step, worlds):
+    """Fault-free reference trajectory: `worlds` maps a step range to the
+    participating rank count (ranks re-pack after the eviction, so the
+    degraded phase equals a fresh (W-1)-rank run at those steps)."""
+    w = np.full((3, 1), 0.25, np.float32)
+    for s in range(total_steps):
+        nranks = worlds(s)
+        grads = []
+        for rank in range(nranks):
+            b = mapfuns.chaos_batch(rank, s)
+            err = (b["x"] @ w)[:, 0] - b["y"]
+            grads.append((2.0 / len(err)) * (b["x"].T @ err)[:, None])
+        w = w - np.float32(0.125) * (sum(grads) / nranks)
+    return w
+
+
+def test_chaos_stall_evicts_at_quorum_w_minus_1_exact(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 15): one member stalls mid-all-reduce (gray: alive
+    and heartbeating, silent on the peer plane).  Survivors detect the
+    straggler, evict it at quorum, and complete the run at W-1 with EXACT
+    step accounting and params equal to a fault-free W-1 reference —
+    total stall->detect->evict->resume well under one collective timeout.
+    The victim is parked (zero supervised restarts), stays fenced through
+    its long probation, and exits cleanly."""
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "600")
+    total_steps = 6
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    cluster = tcluster.run(
+        mapfuns.sync_gray_chaos,
+        {"steps": total_steps, "out_dir": out_dir, "timeout": 30.0,
+         "reform_budget": 4.0, "run_budget": 90.0},
+        num_executors=3, input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(), log_dir=str(tmp_path),
+        heartbeat_interval=0.5, elastic=True,
+        # executor 1 goes gray inside its 3rd all-reduce: steps 0-1 ran at
+        # W=3, step 2 onward must re-run at W=2 after the eviction
+        env={"TOS_FAULTINJECT":
+             "stall_collective:after_rounds=3,secs=8,executor=1,"
+             "incarnation=0"},
+        reservation_timeout=120.0)
+    recs = _await_gray_files(out_dir, [0, 1, 2], 150.0)
+    cluster.shutdown(timeout=300.0)
+    assert all(v is not None for v in recs.values()), recs
+    # survivors: exact step accounting at the degraded world
+    for eid in (0, 2):
+        v = recs[eid]
+        assert v["steps"] == total_steps
+        assert not v["evicted_out"]
+        assert v["effective_world"] == 2
+        assert v["generation"] >= 2
+        assert v["reforms"] >= 1
+        # detect -> evict -> re-form -> first degraded step: well under
+        # one TOS_COLLECTIVE_TIMEOUT (120s default; the thrash baseline)
+        assert v["resume_secs"] is not None and v["resume_secs"] < 30.0
+    # the victim completed exactly the pre-stall steps, then found itself
+    # fenced through probation and bowed out cleanly
+    assert recs[1]["evicted_out"]
+    assert recs[1]["steps"] == 2
+    # no corrupted gradients: survivors identical AND equal to the
+    # fault-free reference (W=3 for steps 0-1, W=2 from step 2)
+    assert recs[0]["final_w"] == recs[2]["final_w"]
+    ref = _gray_reference(total_steps, 2, lambda s: 3 if s < 2 else 2)
+    np.testing.assert_allclose(np.asarray(recs[0]["final_w"]),
+                               ref.ravel(), rtol=1e-4)
+    # eviction accounting: quorum evicted executor 1, the supervisor
+    # PARKED it (no respawn burned), and it sat in probation to the end
+    assert [e["eid"] for e in cluster.coordinator.evictions()] == [1]
+    assert 1 in cluster.coordinator.evicted_members()
+    assert cluster.supervisor is not None
+    assert cluster.supervisor.restart_count(1) == 0
+    assert cluster.supervisor.parked(1)
+    # driver-side telemetry is process-cumulative (earlier tests in this
+    # pytest process may have evicted too): exactness comes from the
+    # server's own eviction log above, the counters assert presence
+    counters = (cluster.metrics().get("counters") or {})
+    assert counters.get("collective.evictions_total", 0) >= 1
+    assert counters.get("collective.suspects_total", 0) >= 1
+    # the run report carries the gray-failure postmortem block
+    with open(os.path.join(str(tmp_path), "run_report.json")) as f:
+        report = json.load(f)
+    assert report["collective"]["evictions_total"] >= 1
+    assert report["collective"]["suspects_total"] >= 1
+
+
+def test_chaos_evicted_node_grows_back_at_generation_barrier(tmp_path,
+                                                             monkeypatch):
+    """Acceptance (ISSUE 15): a short gray stall at W=2 — the survivor
+    evicts the victim and continues ALONE (degraded world 1); the victim
+    recovers, passes its probation health probe on heartbeats, readmits,
+    and GROWS BACK in at a later generation barrier; both members finish
+    the full run on identical params."""
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "1")
+    total_steps = 30
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    cluster = tcluster.run(
+        mapfuns.sync_gray_chaos,
+        {"steps": total_steps, "out_dir": out_dir, "timeout": 20.0,
+         "reform_budget": 60.0, "run_budget": 150.0, "grow_checks": True,
+         "step_delay": 0.25},
+        num_executors=2, input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(), log_dir=str(tmp_path),
+        heartbeat_interval=0.5, elastic=True,
+        env={"TOS_FAULTINJECT":
+             "stall_collective:after_rounds=2,secs=4,executor=1,"
+             "incarnation=0"},
+        reservation_timeout=120.0)
+    recs = _await_gray_files(out_dir, [0, 1], 200.0)
+    cluster.shutdown(timeout=300.0)
+    assert all(v is not None for v in recs.values()), recs
+    # both members finished the full run, together, on identical params
+    for eid in (0, 1):
+        assert recs[eid]["steps"] == total_steps, recs
+        assert not recs[eid]["evicted_out"]
+        assert recs[eid]["effective_world"] == 2  # regrown
+    assert recs[0]["generation"] == recs[1]["generation"]
+    assert recs[0]["generation"] >= 3  # form, evict-reform, grow-reform
+    assert recs[0]["final_w"] == recs[1]["final_w"]
+    # the survivor both evicted (reform 1) and grew the world back
+    # (reform 2); the victim rejoined after readmission
+    assert recs[0]["reforms"] >= 2
+    assert recs[1]["reforms"] >= 1
+    assert [e["eid"] for e in cluster.coordinator.evictions()] == [1]
+    assert cluster.coordinator.evicted_members() == {}  # readmitted
+    counters = (cluster.metrics().get("counters") or {})
+    assert counters.get("collective.evictions_total", 0) >= 1
+    assert counters.get("collective.readmits_total", 0) >= 1
+    # parked at eviction, unparked at readmission, never respawned
+    assert cluster.supervisor is not None
+    assert cluster.supervisor.restart_count(1) == 0
+    assert not cluster.supervisor.parked(1)
+
+
+@pytest.mark.slow
+def test_soak_composed_gray_faults_no_false_eviction(tmp_path, monkeypatch):
+    """Composed gray-fault soak: uniform peer-plane slowness on EVERY node
+    (slow_peer), link flap on one, plus a sub-threshold collective stall —
+    the sync train never deadlocks, finishes exact, and never evicts a
+    HEALTHY member (uniform slowness must not read as a straggler)."""
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "2")
+    total_steps = 25
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    cluster = tcluster.run(
+        mapfuns.sync_gray_chaos,
+        {"steps": total_steps, "out_dir": out_dir, "timeout": 20.0,
+         "reform_budget": 60.0, "run_budget": 240.0, "grow_checks": True},
+        num_executors=3, input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(), log_dir=str(tmp_path),
+        heartbeat_interval=0.5, elastic=True,
+        # uniform slowness everywhere; executor 2 additionally flaps its
+        # liveness; executor 1 takes one brief stall — the only member an
+        # eviction may legitimately touch.  One cluster-wide spec with
+        # executor= filters: ids are registration-order, so per-launch env
+        # could not target deterministically.
+        env={"TOS_FAULTINJECT":
+             "slow_peer:ms=20;"
+             "stall_collective:after_rounds=5,secs=2,executor=1,"
+             "incarnation=0;"
+             "flap:period=2,executor=2"},
+        reservation_timeout=120.0)
+    recs = _await_gray_files(out_dir, [0, 1, 2], 280.0)
+    cluster.shutdown(timeout=300.0)
+    assert all(v is not None for v in recs.values()), recs
+    finals = set()
+    for eid in (0, 1, 2):
+        assert recs[eid]["steps"] == total_steps, recs
+        assert not recs[eid]["evicted_out"]
+        assert recs[eid]["effective_world"] == 3
+        finals.add(tuple(recs[eid]["final_w"]))
+    assert len(finals) == 1  # everyone converged on the same params
+    # no false positives: only the deliberately-stalled member may ever
+    # have been evicted (and if so, it grew back in)
+    evicted_eids = {e["eid"] for e in cluster.coordinator.evictions()}
+    assert evicted_eids <= {1}, cluster.coordinator.evictions()
+    assert cluster.coordinator.evicted_members() == {}
+
+
+def test_eviction_survives_coordinator_crash(tmp_path, monkeypatch):
+    """Eviction is journaled control-plane state: a coordinator crash +
+    journal replay keeps the straggler fenced and in probation (the clock
+    restarts conservatively), and the probation->readmit->relearn ladder
+    still works against the recovered server."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "0.3")
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.0)
+    srv = CoordinatorServer(3, journal_path=str(tmp_path / "j"))
+    try:
+        _form_three(srv)
+        for voter, blamed in ((2, 1), (0, 1)):
+            srv._dispatch({"op": "suspect", "group": "train",
+                           "suspect": blamed, "wait_secs": 3.0,
+                           "executor_id": voter, "incarnation": 0})
+        assert 1 in srv.evicted_members()
+        srv.drain_collective_events()  # monitor drained pre-crash
+        srv.crash()
+        srv.restore()
+        # still evicted, still fenced, effective world still degraded —
+        # and the park/rebalance event is RE-EMITTED so a monitor that
+        # missed (or lost) the original re-applies the side effects
+        assert 1 in srv.evicted_members()
+        assert {(e["kind"], e["eid"])
+                for e in srv.drain_collective_events()} == {("evicted", 1)}
+        assert srv.registered_incarnation(1)[0] == 1
+        assert srv._dispatch({"op": "cworld", "group": "train",
+                              "world": 3})["effective"] == 2
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb["ok"] and hb.get("evicted") and not hb["stop"]
+        # probation (restarted at restore) expires -> readmit + relearn
+        time.sleep(0.35)
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb["ok"] and hb.get("readmit_incarnation") == 1
+        assert srv._dispatch({"op": "cworld", "group": "train",
+                              "world": 3})["effective"] == 3
+    finally:
+        srv.stop()
+
+
+def test_relearn_never_unfences_a_pre_eviction_zombie(monkeypatch):
+    """The readmit-relearn carve-out serves ONLY the readmitted process's
+    own stale clients (exactly incarnation pend-1).  An older zombie — a
+    predecessor from an ordinary death/respawn cycle before the eviction —
+    must stay fenced, or the relearn rider would split-brain the slot."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "0.1")
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.0)
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        # an earlier ordinary death bumped slot 1 to incarnation 1; the
+        # replacement re-registered and rejoined the group
+        srv.mark_dead([1], record_error=False)
+        r = srv._dispatch({"op": "register", "meta": {"host": "h1b"},
+                           "replace": 1})
+        assert r["ok"] and r["incarnation"] == 1
+        # the inc-1 process is then evicted (-> 2) and readmitted
+        for voter, blamed in ((2, 1), (0, 1)):
+            srv._dispatch({"op": "suspect", "group": "train",
+                           "suspect": blamed, "wait_secs": 3.0,
+                           "executor_id": voter, "incarnation": 0})
+        assert 1 in srv.evicted_members()
+        # DURING probation the ancient inc-0 zombie is no probe: it gets
+        # the classic fenced stop (not the evicted reply) and must not
+        # refresh the probation health clock the reaper watches
+        before = srv.evicted_members()[1]["last_ping"]
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb.get("fenced") and hb["stop"] and not hb.get("evicted")
+        assert srv.evicted_members()[1]["last_ping"] == before
+        time.sleep(0.15)
+        # nor may the zombie's ping trigger the readmission at expiry
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb.get("fenced") and hb["stop"]
+        assert 1 in srv.evicted_members()
+        # the evicted process itself (inc 1 = pre-eviction) IS the probe:
+        # its riders merge (the probation window must not be a telemetry
+        # hole) and its post-expiry ping readmits
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 1,
+                            "metrics": {"counters": {"probe.alive": 7}}})
+        assert hb.get("readmit_incarnation") == 2
+        # the readmitted process's stale (inc-1) clients relearn...
+        r = srv._dispatch({"op": "update_meta", "executor_id": 1,
+                           "incarnation": 1, "patch": {}})
+        assert r["ok"] and r.get("readmit_incarnation") == 2
+        # ...but the ANCIENT inc-0 zombie stays fenced: stop=True, no rider
+        hb = srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                            "incarnation": 0})
+        assert hb.get("fenced") and hb["stop"]
+        assert "readmit_incarnation" not in hb
+        # the probation-window metrics rider landed in the cluster view
+        assert srv.cluster_metrics()["counters"].get("probe.alive") == 7
+    finally:
+        srv.stop()
+
+
+def test_silent_probation_reaps_into_ordinary_death(monkeypatch):
+    """An evicted process that dies for real while benched must not stay a
+    ghost: eviction untracked its liveness, so the monitor-side reap
+    converts heartbeat silence in probation into an ordinary death — the
+    slot re-fences, the probation entry drops, and the event feed tells
+    the cluster to unpark + respawn."""
+    from tensorflowonspark_tpu import coordinator as coord_mod
+
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "600")
+    monkeypatch.setattr(coord_mod, "_EVICT_CONFIRM_SECS", 0.0)
+    srv = CoordinatorServer(3)
+    try:
+        _form_three(srv)
+        for voter, blamed in ((2, 1), (0, 1)):
+            srv._dispatch({"op": "suspect", "group": "train",
+                           "suspect": blamed, "wait_secs": 3.0,
+                           "executor_id": voter, "incarnation": 0})
+        assert 1 in srv.evicted_members()
+        srv.drain_collective_events()
+        # still pinging: not reaped
+        srv._dispatch({"op": "heartbeat", "executor_id": 1,
+                       "incarnation": 0})
+        assert srv.reap_silent_probation(10.0) == []
+        time.sleep(0.25)
+        assert srv.reap_silent_probation(0.2) == [1]
+        assert srv.evicted_members() == {}
+        assert srv.registered_incarnation(1)[0] == 2  # re-fenced past both
+        assert [e["kind"] for e in srv.drain_collective_events()] == \
+            ["probation_death"]
+        # a supervised replacement may register now (slot no longer parked)
+        r = srv._dispatch({"op": "register", "meta": {"host": "h1c"},
+                           "replace": 1})
+        assert r["ok"] and r["incarnation"] == 2
+    finally:
+        srv.stop()
+
+
+def test_resolve_blame_cycles_and_chains_off_ring():
+    """The blame walk must terminate on REVISIT (cycle -> None), not on
+    visited-node exclusion — off-ring topologies (naive gather-broadcast)
+    produce fan-in blame where the old exclusion walk would terminate a
+    uniform-slowness cycle on an arbitrary healthy member and convict it."""
+    resolve = CoordinatorServer._resolve_blame_locked
+    # genuine ring chain: straggler 1 blamed by 2; 2 blamed by 0 -> both 1
+    reports = {1: {2: 0.0}, 2: {0: 0.0}}
+    assert resolve(reports, 1) == 1
+    assert resolve(reports, 2) == 1
+    # ring cycle (uniform slowness): every walk revisits -> None
+    reports = {2: {0: 0.0}, 1: {2: 0.0}, 0: {1: 0.0}}
+    assert all(resolve(reports, b) is None for b in (0, 1, 2))
+    # naive (star) uniform slowness at W=4: root 0 blames 1-3, they blame
+    # 0 back — fan-in cycles everywhere, nobody convicted
+    reports = {1: {0: 0.0}, 2: {0: 0.0}, 3: {0: 0.0}, 0: {1: 0.0, 2: 0.0,
+                                                          3: 0.0}}
+    assert all(resolve(reports, b) is None for b in (0, 1, 2, 3))
+    # naive genuine stall: non-root 2 stalls — root blames 2, the other
+    # leaves blame the root (waiting on the result) -> all converge on 2
+    reports = {2: {0: 0.0}, 0: {1: 0.0, 3: 0.0}}
+    assert resolve(reports, 2) == 2
+    assert resolve(reports, 0) == 2
+
+
+def test_faultinject_fractional_stall_secs():
+    from tensorflowonspark_tpu.faultinject import FaultPlan
+
+    plan = FaultPlan.parse("stall_collective:after_rounds=1,secs=2.5")
+    plan.set_identity(0, 0)
+    assert plan.stall_secs() == 2.5
