@@ -457,6 +457,201 @@ def bench_trace(quick: bool = False, *, max_batch: int = 64,
     return results
 
 
+def _tenant_loop(gateway, tenant: str, rows, duration: float,
+                 latencies: list[float], shed: list[float],
+                 errors: list[str], pace: float) -> None:
+    """One closed-loop in-process caller for one tenant; ``ServeThrottled``
+    (the per-tenant 429) lands in ``shed`` and the client backs off 1ms —
+    the documented retry contract, and it keeps the fairness numbers about
+    the queues instead of a rejected caller busy-spinning the driver's one
+    core.  Anything else is a failure."""
+    from tensorflowonspark_tpu.serving import ServeThrottled
+
+    deadline = time.perf_counter() + duration
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        try:
+            gateway.predict(rows, timeout=30.0, tenant=tenant)
+            latencies.append(time.perf_counter() - t0)
+        except ServeThrottled:
+            shed.append(time.perf_counter() - t0)
+            time.sleep(0.001)
+        except Exception as e:  # noqa: BLE001 - surfaced by the caller
+            errors.append(f"{tenant}: {type(e).__name__}: {e}")
+            return
+        if pace:
+            time.sleep(pace)
+
+
+def _run_tenants(gateway, specs, duration: float) -> dict:
+    """Drive every (tenant, rows, pace) spec concurrently; per-tenant
+    answered/shed counts + latency percentiles."""
+    lanes = {t: ([], [], []) for t, _, _ in specs}  # lat, shed, errors
+    threads = [threading.Thread(target=_tenant_loop,
+                                args=(gateway, t, rows, duration,
+                                      *lanes[t], pace))
+               for t, rows, pace in specs]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    out = {}
+    for t, (lat, shed, errors) in lanes.items():
+        if errors:
+            raise RuntimeError(f"bench tenant failed: {errors[0]}")
+        vals = sorted(lat)
+        total = len(lat) + len(shed)
+        out[t] = {
+            "requests": total,
+            "answered": len(lat),
+            "shed": len(shed),
+            "shed_pct": round(len(shed) / total * 100, 1) if total else 0.0,
+            "p50_ms": round(_percentile(vals, 0.50) * 1e3, 2),
+            "p99_ms": round(_percentile(vals, 0.99) * 1e3, 2),
+        }
+    return out
+
+
+def bench_r17(quick: bool = False, *, num_nodes: int = 2) -> dict:
+    """--scenario r17: safe-rollout robustness (ISSUE 16), three phases on
+    one cluster.
+
+    1. **baseline** — tenants ``a``/``b`` uncontended closed-loop 1-row
+       traffic (their own p99 floor for the fairness compare);
+    2. **hot flood** — tenant ``hot`` drives 16-row requests with every
+       token-bucket charge amplified 10x (the ``hot_tenant`` chaos hook),
+       i.e. a sustained 10x-over-budget flood, while a/b keep their pace.
+       Headline: a/b p99 under the flood vs phase 1, hot's shed rate;
+    3. **canary swap mid-burst** — with the flood still running, a
+       candidate bundle staged with ``bad_model`` NaN corruption rolls
+       out to half the fleet (shadow mirroring on); the governor detects
+       the regression and rolls the canaries back.  Headline:
+       detection->restored latency (``rollback_secs``) and zero
+       non-throttle request errors across all three phases.
+
+    Same-run interleaving (flood + rollout share the burst) is the
+    methodology on this box: separate phases would absorb drift.
+    """
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu import faultinject, serving, telemetry
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import linear as linmod
+
+    import numpy as np
+
+    feature_dim = 8
+    duration = 2.0 if quick else 6.0
+    rate = 400.0
+    config = {"model": "linear", "in_dim": feature_dim,
+              "out_dim": feature_dim}
+    results: dict = {"scenario": "r17", "num_nodes": num_nodes,
+                     "tenant_rate_rows_per_s": rate, "hot_charge_mult": 10,
+                     "duration_s": duration}
+    telemetry.reset()
+    os.environ["TOS_SERVE_TENANT_RATE"] = str(rate)
+    # driver-side chaos: amplify the hot tenant's admission charge 10x
+    os.environ["TOS_FAULTINJECT"] = "hot_tenant:mult=10,tenant=hot"
+    faultinject.init_from_env(force=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        export = os.path.join(tmp, "bundle")
+        candidate = os.path.join(tmp, "candidate")
+        export_bundle(export, linmod.init_params(config, scale=2.0), config)
+        export_bundle(candidate, linmod.init_params(config, scale=2.0),
+                      config)
+        cluster = tcluster.run(
+            serving.serving_loop,
+            {"export_dir": export, "max_batch": 16},
+            num_executors=num_nodes,
+            input_mode=tcluster.InputMode.STREAMING,
+            heartbeat_interval=0.5,
+            reservation_timeout=120.0,
+            # node-side chaos: candidate bundles emit NaN (fires only once
+            # a replica is serving a rollout CANDIDATE — phase 3)
+            env={"TOS_FAULTINJECT": "bad_model:nan=1"},
+        )
+        try:
+            gateway = cluster.serve(export, max_batch=16, max_delay_ms=2.0,
+                                    queue_limit=256, listen=False,
+                                    reload_poll_secs=0)
+            one = [np.arange(feature_dim, dtype=np.float32)]
+            hot_rows = [np.arange(feature_dim, dtype=np.float32)] * 16
+            gateway.predict(one, timeout=30.0)  # warmup: compile replicas
+            results["baseline"] = _run_tenants(
+                gateway, [("a", one, 0.01), ("b", one, 0.01)], duration)
+
+            flood = [("a", one, 0.01), ("b", one, 0.01),
+                     ("hot", hot_rows, 0.0)]
+            lanes = {t: ([], [], []) for t, _, _ in flood}
+            threads = [threading.Thread(target=_tenant_loop,
+                                        args=(gateway, t, rows,
+                                              duration + 2.0, *lanes[t],
+                                              pace))
+                       for t, rows, pace in flood]
+            for th in threads:
+                th.start()
+            time.sleep(1.0)  # the burst is established; swap mid-burst
+            t_roll = time.perf_counter()
+            gov = gateway.rollout(candidate, canary_pct=50, shadow=True,
+                                  window_secs=2.0)
+            status = gov.wait(timeout=30.0)
+            roll_wall = time.perf_counter() - t_roll
+            for th in threads:
+                th.join()
+            out = {}
+            for t, (lat, shed, errors) in lanes.items():
+                if errors:
+                    raise RuntimeError(f"bench tenant failed: {errors[0]}")
+                vals = sorted(lat)
+                total = len(lat) + len(shed)
+                out[t] = {"requests": total, "answered": len(lat),
+                          "shed": len(shed),
+                          "shed_pct": round(len(shed) / total * 100, 1)
+                          if total else 0.0,
+                          "p50_ms": round(_percentile(vals, 0.50) * 1e3, 2),
+                          "p99_ms": round(_percentile(vals, 0.99) * 1e3, 2)}
+            results["flood"] = out
+            gs = gov.status()
+            results["rollout"] = {
+                "status": status,
+                "reason": gov.state.reason,
+                "rollback_secs": gs["rollback_secs"],
+                "wall_secs": round(roll_wall, 2),
+                "shadow_mirrors":
+                    telemetry.counter("serve.shadow_mirrors").value(),
+                "rollbacks_total":
+                    telemetry.counter("serve.rollbacks_total").value(),
+            }
+        finally:
+            cluster.shutdown(timeout=120.0)
+            os.environ.pop("TOS_FAULTINJECT", None)
+            os.environ.pop("TOS_SERVE_TENANT_RATE", None)
+            faultinject.init_from_env(force=True)
+    return results
+
+
+def r17_table(results: dict) -> str:
+    lines = [f"### r17: hot-tenant flood + canary swap mid-burst "
+             f"({results['num_nodes']} nodes, rate="
+             f"{results['tenant_rate_rows_per_s']:g} rows/s/tenant, "
+             f"hot charge x{results['hot_charge_mult']})",
+             "| tenant | phase | requests | shed % | p50 ms | p99 ms |",
+             "|---|---|---|---|---|---|"]
+    for phase in ("baseline", "flood"):
+        for t, r in sorted(results[phase].items()):
+            lines.append(f"| {t} | {phase} | {r['requests']} | "
+                         f"{r['shed_pct']} | {r['p50_ms']} | {r['p99_ms']} |")
+    ro = results["rollout"]
+    lines.append("")
+    lines.append(f"rollout mid-burst: {ro['status']} "
+                 f"(reason: {ro['reason']}); detection->restored "
+                 f"{ro['rollback_secs']:.2f}s, start->resolved "
+                 f"{ro['wall_secs']:.2f}s wall, "
+                 f"{ro['shadow_mirrors']} shadow mirrors diffed"
+                 if ro["rollback_secs"] is not None else
+                 f"rollout mid-burst: {ro['status']} (reason: {ro['reason']})")
+    return "\n".join(lines)
+
+
 def bench(quick: bool = False, *, max_batch: int = 64,
           num_nodes: int = 2) -> dict:
     from tensorflowonspark_tpu import cluster as tcluster
@@ -549,7 +744,40 @@ def main(argv=None) -> int:
                     help="per-stage p50/p99 from a sampled traced run plus "
                          "an interleaved TOS_TRACE off-vs-on overhead "
                          "compare (BENCH_r10)")
+    ap.add_argument("--scenario", default="",
+                    help="named robustness scenario: 'r17' = hot-tenant "
+                         "flood + canary swap mid-burst with an injected "
+                         "regression -> auto-rollback (BENCH_r17)")
     args = ap.parse_args(argv)
+    if args.scenario:
+        if args.scenario != "r17":
+            ap.error(f"unknown scenario {args.scenario!r}")
+        results = bench_r17(quick=args.quick)
+        print(r17_table(results))
+        fair_ok = all(
+            results["flood"][t]["p99_ms"] <=
+            max(2.0 * results["baseline"][t]["p99_ms"],
+                results["baseline"][t]["p99_ms"] + 250.0)
+            for t in ("a", "b"))
+        fair_ok = fair_ok and results["flood"]["hot"]["shed"] > 0 and \
+            not results["flood"]["a"]["shed"] and \
+            not results["flood"]["b"]["shed"]
+        ro = results["rollout"]
+        roll_ok = (ro["status"] == "rolled_back"
+                   and ro["rollback_secs"] is not None
+                   and ro["rollback_secs"] <= 5.0)
+        print(f"acceptance r17a (a/b p99 under hot flood <= 2x their "
+              f"uncontended p99; only hot shed): "
+              f"{'PASS' if fair_ok else 'MISS'}")
+        print(f"acceptance r17b (injected regression auto-rolls-back, "
+              f"detection->restored <= 5s): "
+              f"{'PASS' if roll_ok else 'MISS'} "
+              f"({ro['rollback_secs']}s, status={ro['status']})")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+            print(f"raw results -> {args.json}")
+        return 0
     if args.trace_breakdown:
         results = bench_trace(quick=args.quick)
         print(trace_table(results))

@@ -467,6 +467,11 @@ _THREADED_BASENAMES = frozenset({
     # the online-serving subsystem is thread-per-replica + flush/watch
     # threads throughout — same race classes, same discipline
     "gateway.py", "batcher.py", "router.py",
+    # staged rollouts + tenant fairness: the governor thread shares its
+    # sliding windows with router workers (rollout.py), and the tenant
+    # queues (tenancy.py) are owned by the batcher under ITS lock — new
+    # locked sections added there must keep the same discipline
+    "rollout.py", "tenancy.py",
     # the reactor frontend: completion threads hand replies to the reactor
     "frontend.py",
     # the DIRECT-mode ingest pipeline: claimer + reader pool + consumer —

@@ -361,6 +361,25 @@ def load_bundle(export_dir: str) -> tuple[Any, dict]:
     return params, config
 
 
+def bundle_signature(export_dir: str) -> tuple:
+    """Cheap change signature of an exported bundle: (name, mtime_ns, size)
+    per bundle file.  ``export_bundle`` commits params.npz by atomic rename,
+    so a changed signature is a COMPLETE newer export, never a torn one.
+    The gateway's version watcher polls this to detect new exports, and the
+    rollout/promotion path compares each replica's reload-ack signature
+    against it to prove the whole fleet converged on one bundle (a replica
+    acking a different signature is flight-recorded as a laggard)."""
+    local = resolve_uri(export_dir)
+    sig = []
+    for name in ("bundle.json", "params.npz", "params"):
+        try:
+            st = os.stat(os.path.join(local, name))
+        except OSError:
+            continue
+        sig.append((name, st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
 def export_stablehlo(export_dir: str, params: Any, model_config: dict,
                      input_shape: tuple, input_dtype: Any = None,
                      batch_polymorphic: bool = True,

@@ -287,6 +287,11 @@ class CoordinatorServer:
         # restores which replicas were serving — statz/run-report evidence
         # operators read after the fact.
         self._serving: dict[str, list[int]] = {}
+        # Staged-rollout registry (ISSUE 16): each gateway journals its
+        # in-flight rollout's state (candidate/prior/canary cohort/status)
+        # here, so a control-plane failover restores what was mid-rollout
+        # and statz shows promotions/rollbacks after the fact.
+        self._rollouts: dict[str, dict] = {}
         # Gray-failure tolerance (ISSUE 15): suspicion votes per collective
         # group ({group: {suspect_eid: {voter_eid: mono_time}}}), the live
         # membership each group's last `form` produced, members EVICTED at
@@ -509,6 +514,7 @@ class CoordinatorServer:
             "manifest": dict(self._manifest),
             "errors": [dict(e) for e in self._errors],
             "serving": {k: list(v) for k, v in self._serving.items()},
+            "rollouts": {k: dict(v) for k, v in self._rollouts.items()},
             # gray-failure state: who sits in probation (probation clocks
             # are monotonic and restart conservatively at restore) and who
             # is mid-relearn of a readmitted incarnation
@@ -585,6 +591,7 @@ class CoordinatorServer:
             self._errors = []
             self._manifest = {}
             self._serving = {}
+            self._rollouts = {}
             self._rdv = {}
             self._suspicions = {}
             self._evict_pending = {}
@@ -627,6 +634,8 @@ class CoordinatorServer:
             self._errors = [dict(e) for e in snap.get("errors") or []]
             self._serving = {k: [int(x) for x in v] for k, v in
                              (snap.get("serving") or {}).items()}
+            self._rollouts = {k: dict(v) for k, v in
+                              (snap.get("rollouts") or {}).items()}
             self._evicted = {}
             for e, group in (snap.get("evicted") or {}).items():
                 self._restore_evicted_locked(int(e), str(group))
@@ -742,6 +751,8 @@ class CoordinatorServer:
         elif kind == "serving":
             self._serving[str(d.get("gateway"))] = \
                 [int(x) for x in d.get("replicas") or []]
+        elif kind == "rollout":
+            self._rollouts[str(d.get("gateway"))] = dict(d.get("state") or {})
         elif kind == "evict":
             eid = int(d["eid"])
             untracked.add(eid)
@@ -799,6 +810,19 @@ class CoordinatorServer:
     def serving_replicas(self) -> dict[str, list[int]]:
         with self._lock:
             return {k: list(v) for k, v in self._serving.items()}
+
+    def note_rollout(self, gateway: str, state: dict) -> None:
+        """Record one gateway's staged-rollout state (journaled, restored
+        across a control-plane failover): the full payload on start, then
+        re-noted on every transition (promoted / rolled_back / aborted)."""
+        with self._lock:
+            self._rollouts[str(gateway)] = dict(state or {})
+            self._log("rollout", gateway=str(gateway),
+                      state=self._rollouts[str(gateway)])
+
+    def rollout_state(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._rollouts.items()}
 
     # -- gray-failure eviction (straggler suspicion -> quorum -> probation) ---
 
@@ -1439,6 +1463,9 @@ class CoordinatorServer:
             # healthy as of its last publish (survives a coordinator
             # failover — the epoch shows whether one happened)
             "replica_registry": self.serving_replicas(),
+            # staged rollouts: what each gateway has in flight (or last
+            # resolved) — same journal-backed failover story
+            "rollouts": self.rollout_state(),
             "coordinator_epoch": self._epoch,
             "feed_queue_depth": {
                 key: (s.get("gauges") or {}).get("feed.queue_depth")

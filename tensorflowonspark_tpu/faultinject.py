@@ -50,6 +50,19 @@ deterministically while its peers stay healthy):
   in the armed process, for as long as it lives.  Armed on every node it
   models uniform slowness — the false-positive case eviction must never
   fire on; armed on one it models the persistent outlier.
+- ``bad_model:nan=1,ms=M`` — model regression on the CANDIDATE bundle:
+  while this serving replica is serving a rollout candidate (never the
+  boot/primary bundle — the hook carries that bit), every batch's outputs
+  are corrupted to NaN (``nan=1``) and/or delayed M milliseconds (hook:
+  ``serving/loop.py``).  Models a bad export mid-canary: the rollout
+  governor must detect the divergence/latency and auto-roll-back with
+  zero failed primary requests.
+- ``hot_tenant:mult=K,tenant=T`` — driver-side overload amplifier: every
+  admission-time token-bucket charge for tenant T (all tenants when
+  ``tenant`` is omitted) is multiplied by K (hook:
+  ``serving/tenancy.py``), so a modest real load presents as K× the
+  tenant's rate limit.  Models one tenant flooding: only T may see
+  throttled replies while other tenants keep their p99.
 
 Spec grammar (``TOS_FAULTINJECT``): semicolon-separated actions, each
 ``name:key=value,key=value`` —
@@ -135,18 +148,29 @@ class FaultPlan:
              # count — see _CONTINUOUS
              "delay_net": "ms",
              "slow_peer": "ms",
-             "flap": "period"}
-    # optional secondary keys per action (int-valued)
-    _EXTRA_KEYS = {"stall_collective": frozenset({"secs"})}
+             "flap": "period",
+             # candidate-bundle regression: nan=1 corrupts outputs, the
+             # ms= extra inflates latency — fires only while the serving
+             # replica is on a rollout CANDIDATE bundle (see serving/loop)
+             "bad_model": "nan",
+             # driver-side tenant-flood amplifier: every token-bucket
+             # charge for the targeted tenant is multiplied by `mult`
+             "hot_tenant": "mult"}
+    # optional secondary keys per action (float-valued)
+    _EXTRA_KEYS = {"stall_collective": frozenset({"secs"}),
+                   "bad_model": frozenset({"ms"})}
+    # optional string-valued keys per action (never int-coerced)
+    _STR_KEYS = {"hot_tenant": frozenset({"tenant"})}
     # one-shot actions fire once when the counter REACHES the threshold;
     # windowed actions fire on EVERY call until the threshold is spent
     # (drop_heartbeats swallows the first K pings — one dropped ping would
     # never outlast the driver's dead-node timeout)
     _WINDOWED = frozenset({"drop_heartbeats"})
     # continuous actions never "fire and disarm": they degrade the process
-    # for its whole life (delay_net / slow_peer) or on a periodic schedule
-    # (flap)
-    _CONTINUOUS = frozenset({"delay_net", "slow_peer", "flap"})
+    # for its whole life (delay_net / slow_peer / bad_model / hot_tenant)
+    # or on a periodic schedule (flap)
+    _CONTINUOUS = frozenset({"delay_net", "slow_peer", "flap", "bad_model",
+                             "hot_tenant"})
 
     def __init__(self, actions: list[_Action]):
         self._lock = threading.Lock()
@@ -181,16 +205,21 @@ class FaultPlan:
                     # registration-order and so cannot ride per_node_env
                     role = v.strip()
                     continue
-                # secondary parameters (e.g. stall secs) may be fractional;
+                # secondary parameters (e.g. stall secs) may be fractional
+                # and a few (e.g. hot_tenant's tenant=) are strings;
                 # thresholds/filters stay integral
-                kv[k] = (float(v)
-                         if k in cls._EXTRA_KEYS.get(name, frozenset())
-                         else int(v))
+                if k in cls._STR_KEYS.get(name, frozenset()):
+                    kv[k] = v.strip()
+                else:
+                    kv[k] = (float(v)
+                             if k in cls._EXTRA_KEYS.get(name, frozenset())
+                             else int(v))
             threshold = kv.pop(cls._KEYS[name], 1)
             executor = kv.pop("executor", None)
             incarnation = kv.pop("incarnation", None)
             extra = {k: kv.pop(k) for k in list(kv)
-                     if k in cls._EXTRA_KEYS.get(name, frozenset())}
+                     if k in (cls._EXTRA_KEYS.get(name, frozenset())
+                              | cls._STR_KEYS.get(name, frozenset()))}
             if kv:
                 raise ValueError(f"unknown keys {sorted(kv)} for fault {name!r}")
             actions.append(_Action(name, threshold, executor, incarnation,
@@ -447,6 +476,50 @@ def coordinator_op() -> bool:
     True = ``kill_coordinator`` fires now (the server crash()es itself —
     the journaled-recovery path owns what happens next)."""
     return _PLAN is not None and bool(_PLAN._tick("kill_coordinator"))
+
+
+def bad_model(candidate: bool) -> tuple[bool, float]:
+    """Hook: one serving micro-batch is about to be answered
+    (``serving/loop.py``); returns ``(corrupt_outputs, extra_latency_secs)``.
+    Fires only while the replica serves a rollout CANDIDATE bundle
+    (``candidate`` — the reload control item carried the bit), so the
+    injected regression models a bad export, never a bad fleet: primary
+    replicas keep answering correctly while the canary cohort degrades."""
+    if _PLAN is None or not candidate:
+        return False, 0.0
+    a = _PLAN._armed("bad_model")
+    if a is None:
+        return False, 0.0
+    with _PLAN._lock:
+        first = not a.fired
+        a.fired = True
+        a.count += 1
+    if first:
+        _PLAN._count_injection("bad_model")
+    return bool(a.threshold), float(a.extra.get("ms", 0.0)) / 1e3
+
+
+def tenant_charge_mult(tenant: str) -> int:
+    """Hook: the serving admission path is about to charge ``tenant``'s
+    token bucket (``serving/tenancy.py``); returns the charge multiplier
+    (1 = unarmed).  ``hot_tenant:mult=K,tenant=T`` makes tenant T's real
+    load present as K× its rate budget — the deterministic stand-in for a
+    flooding client."""
+    if _PLAN is None:
+        return 1
+    a = _PLAN._armed("hot_tenant")
+    if a is None:
+        return 1
+    target = a.extra.get("tenant", "")
+    if target and target != tenant:
+        return 1
+    with _PLAN._lock:
+        first = not a.fired
+        a.fired = True
+        a.count += 1
+    if first:
+        _PLAN._count_injection("hot_tenant")
+    return max(1, a.threshold)
 
 
 def net_delay() -> None:

@@ -10,15 +10,21 @@ stack's missing half — see ``gateway.py`` for the architecture):
 - :class:`GatewayClient` / :class:`GatewayClientPool` — the pipelined
   remote caller (many id-tagged requests outstanding per socket) and a
   connection pool for closed-loop caller fleets;
-- :class:`MicroBatcher` — dynamic micro-batching + admission control;
+- :class:`MicroBatcher` — dynamic micro-batching + admission control
+  (per-tenant weighted DRR queues, token-bucket rate limits, and the
+  brownout shed ladder — ``tenancy.py``);
 - :class:`ReplicaRouter` — least-outstanding routing, death retry,
-  incarnation-fenced recovery;
+  incarnation-fenced recovery, cohort-split rollout routing;
+- :class:`RolloutGovernor` — shadow/canary staged rollouts with
+  auto-promote / auto-rollback (``gateway.rollout``, ``rollout.py``);
 - :func:`serving_loop` — the resident node map_fun.
 
 Tuning knobs: ``TOS_SERVE_QUEUE``, ``TOS_SERVE_MAX_BATCH``,
 ``TOS_SERVE_MAX_DELAY_MS``, ``TOS_SERVE_TIMEOUT``,
-``TOS_SERVE_HANDSHAKE_TIMEOUT``, ``TOS_SERVE_CONN_OUTSTANDING`` (see the
-README table).
+``TOS_SERVE_HANDSHAKE_TIMEOUT``, ``TOS_SERVE_CONN_OUTSTANDING``,
+``TOS_SERVE_CANARY_PCT``, ``TOS_SERVE_ROLLOUT_WINDOW_SECS``,
+``TOS_SERVE_TENANT_RATE``, ``TOS_SERVE_SHED_LADDER`` (see the README
+table).
 """
 
 from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
@@ -27,6 +33,7 @@ from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401
     PendingPrediction,
     ServeClosed,
     ServeQueueFull,
+    ServeThrottled,
     ServeTimeout,
 )
 from tensorflowonspark_tpu.serving.frontend import ReactorFrontend  # noqa: F401
@@ -38,4 +45,9 @@ from tensorflowonspark_tpu.serving.gateway import (  # noqa: F401
     ServingGateway,
 )
 from tensorflowonspark_tpu.serving.loop import serving_loop  # noqa: F401
+from tensorflowonspark_tpu.serving.rollout import (  # noqa: F401
+    RolloutGovernor,
+    RolloutState,
+)
 from tensorflowonspark_tpu.serving.router import ReplicaRouter  # noqa: F401
+from tensorflowonspark_tpu.serving.tenancy import TenantQueues  # noqa: F401

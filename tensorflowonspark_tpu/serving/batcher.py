@@ -12,7 +12,12 @@ Admission control happens here too: the request queue is bounded
 (``TOS_SERVE_QUEUE``) and an arriving request that finds it full is
 rejected immediately with :class:`ServeQueueFull` (the 503 of this wire
 protocol) — a loaded gateway sheds load at the door instead of growing an
-unbounded latency tail.  Every request carries a deadline
+unbounded latency tail.  The queue itself is tenant-aware
+(:class:`~.tenancy.TenantQueues`): requests carry an optional tenant key,
+admission applies per-tenant token-bucket rate limits and the brownout
+ladder (:class:`~.tenancy.ServeThrottled` — the 429), and batch building
+drains the per-tenant FIFOs deficit-round-robin so one hot tenant cannot
+monopolize batch fill.  Every request carries a deadline
 (``TOS_SERVE_TIMEOUT`` default); requests that expire while still queued
 are dropped before dispatch, and a late result for an expired waiter is
 discarded — each accepted request is answered exactly once, with either
@@ -24,13 +29,16 @@ position, and a request larger than ``max_batch`` simply spans batches.
 
 from __future__ import annotations
 
-import collections
 import logging
 import threading
 from time import monotonic as _monotonic
 from typing import Any, Callable, Sequence
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.serving.tenancy import (  # noqa: F401 - ServeThrottled re-exported
+    ServeThrottled,
+    TenantQueues,
+)
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 
 logger = logging.getLogger(__name__)
@@ -60,10 +68,11 @@ class _Request:
 
     __slots__ = ("rows", "results", "remaining", "offset", "error",
                  "event", "deadline", "t_submit", "dispatched_at",
-                 "callbacks", "trace", "resolved_at")
+                 "callbacks", "trace", "resolved_at", "tenant")
 
-    def __init__(self, rows: list, deadline: float):
+    def __init__(self, rows: list, deadline: float, tenant: str = ""):
         self.rows = rows
+        self.tenant = tenant
         self.results: list = [None] * len(rows)
         self.remaining = len(rows)
         self.offset = 0              # rows already pulled into batches
@@ -88,7 +97,7 @@ class MicroBatch:
     re-dispatches after a replica failure (the router allows one)."""
 
     __slots__ = ("rows", "n", "entries", "retries", "created_at",
-                 "trace", "trace_parent")
+                 "trace", "trace_parent", "cohort", "mirror_of")
 
     def __init__(self, rows: list, n: int,
                  entries: list[tuple[_Request, int, int, int]]):
@@ -97,6 +106,12 @@ class MicroBatch:
         self.entries = entries
         self.retries = 0
         self.created_at = _monotonic()
+        # rollout support (router-owned): which replica cohort this batch
+        # must run on (None = router decides at submit); a shadow MIRROR
+        # batch carries the primary's results here for output diffing and
+        # has no entries — nothing waits on it
+        self.cohort: str | None = None
+        self.mirror_of: list | None = None
         # batch span context: derived from the FIRST sampled request in the
         # batch (the batcher "links N request spans to their batch span" —
         # the other sampled requests are listed in the span's link tags);
@@ -142,7 +157,8 @@ class MicroBatcher:
     def __init__(self, dispatch: Callable[[MicroBatch], None], *,
                  max_batch: int, max_delay_secs: float, queue_limit: int,
                  pause_fn: Callable[[], bool] | None = None,
-                 capacity_fn: Callable[[], bool] | None = None):
+                 capacity_fn: Callable[[], bool] | None = None,
+                 tenant_weights: dict[str, float] | None = None):
         self.max_batch = max(1, int(max_batch))
         self.max_delay = max(0.0, float(max_delay_secs))
         self.queue_limit = max(1, int(queue_limit))
@@ -150,7 +166,10 @@ class MicroBatcher:
         self._pause_fn = pause_fn or (lambda: False)
         self._capacity_fn = capacity_fn or (lambda: True)
         self._cond = threading.Condition()
-        self._queue: collections.deque[_Request] = collections.deque()
+        # tenant-aware admission queue (per-tenant FIFOs, DRR drain, token
+        # buckets, brownout ladder) — owned here, every access under _cond
+        self._queue = TenantQueues(queue_limit=self.queue_limit,
+                                   weights=tenant_weights)
         self._rows_queued = 0
         self._closed = False
         # requests finished while the lock was held, their callbacks not yet
@@ -163,29 +182,33 @@ class MicroBatcher:
 
     # -- admission -----------------------------------------------------------
 
-    def submit(self, rows: Sequence[Any], deadline: float) -> _Request:
+    def submit(self, rows: Sequence[Any], deadline: float,
+               tenant: str = "") -> _Request:
         """Admit one request or fast-fail; never blocks on a full queue."""
         rows = list(rows)
         if not rows:
             raise ValueError("predict needs at least one row")
-        res = self.submit_many([(rows, deadline, None)])[0]
+        res = self.submit_many([(rows, deadline, None, tenant)])[0]
         if isinstance(res, Exception):
             raise res
         return res
 
     def submit_many(self, entries: list) -> list:
         """Bulk admission for the reactor: admit ``[(rows, deadline,
-        done_cb), ...]`` under ONE lock acquisition with ONE flush-loop
-        notify — a pipelined burst decoded in one read pass costs one
-        critical section, not one per request.  Returns one entry per
-        input: the admitted request, or the admission error instance
-        (:class:`ServeClosed` / :class:`ServeQueueFull`) for refusals.
-        Callbacks are attached inside the lock, so a request can never
-        resolve before its callback is registered."""
+        done_cb[, tenant]), ...]`` under ONE lock acquisition with ONE
+        flush-loop notify — a pipelined burst decoded in one read pass
+        costs one critical section, not one per request.  Returns one
+        entry per input: the admitted request, or the admission error
+        instance (:class:`ServeClosed` / :class:`ServeQueueFull` /
+        :class:`~.tenancy.ServeThrottled`) for refusals.  Callbacks are
+        attached inside the lock, so a request can never resolve before
+        its callback is registered."""
         out: list = []
         accepted = rows_total = 0
         with self._cond:
-            for rows, deadline, done_cb in entries:
+            for entry in entries:
+                rows, deadline, done_cb = entry[0], entry[1], entry[2]
+                tenant = entry[3] if len(entry) > 3 else ""
                 if self._closed:
                     out.append(ServeClosed("serving gateway is closed"))
                     continue
@@ -195,7 +218,11 @@ class MicroBatcher:
                         f"request queue full ({self.queue_limit} queued); "
                         "retry later or add replicas"))
                     continue
-                req = _Request(rows, deadline)
+                shed = self._queue.admission_error(tenant, len(rows))
+                if shed is not None:
+                    out.append(shed)
+                    continue
+                req = _Request(rows, deadline, tenant)
                 # gateway-side trace stamping: the deterministic sampler
                 # (TOS_TRACE_SAMPLE) decides here, once, for the request's
                 # whole cross-process life; None costs one check downstream
@@ -214,6 +241,17 @@ class MicroBatcher:
             telemetry.counter("serve.requests_total").inc(accepted)
             telemetry.counter("serve.rows_total").inc(rows_total)
         return out
+
+    def shed_level(self) -> int:
+        """Current brownout rung (0 = normal) — the rollout layer pauses
+        shadow mirroring at level >= 1; see ``tenancy.TenantQueues``."""
+        with self._cond:
+            return self._queue.shed_level()
+
+    def tenant_depths(self) -> dict[str, int]:
+        """Queued requests per tenant — the per-tenant stats surface."""
+        with self._cond:
+            return self._queue.depths()
 
     def await_request(self, req: _Request) -> list:
         """Block until the request resolves or its deadline passes; returns
@@ -290,12 +328,16 @@ class MicroBatcher:
                     if self._done_pending:
                         break  # run expiry callbacks before waiting again
                     if self._queue and not self._pause_fn():
-                        age = _monotonic() - self._queue[0].t_submit
+                        oldest = self._queue.oldest_submit()
+                        age = (_monotonic() - oldest if oldest is not None
+                               else 0.0)
                         ripe = (self._rows_queued >= self.max_batch
                                 or age >= self.max_delay)
                         if ripe and self._capacity_fn():
                             batch = self._build_batch_locked()
-                            break
+                            if batch is not None:
+                                break
+                            continue  # only already-resolved requests queued
                         # ripe but no downstream capacity: hold — completion
                         # notifies this cond, and every arrival meanwhile
                         # raises the eventual batch's fill
@@ -322,17 +364,22 @@ class MicroBatcher:
         if expired:
             self._depth.set(len(self._queue))
 
-    def _build_batch_locked(self) -> MicroBatch:
+    def _build_batch_locked(self) -> MicroBatch | None:
+        """Pull up to ``max_batch`` rows in deficit-round-robin tenant
+        order (``TenantQueues.next_for_batch``); None when everything
+        queued turned out to be already resolved."""
         rows: list = []
         entries: list[tuple[_Request, int, int, int]] = []
         now = _monotonic()
-        while self._queue and len(rows) < self.max_batch:
-            req = self._queue[0]
+        while len(rows) < self.max_batch:
+            req = self._queue.next_for_batch()
+            if req is None:
+                break
             if req.event.is_set():
                 # already resolved (expired, or an earlier slice's batch
                 # failed): its queued tail must not reach a replica or keep
                 # occupying an admission slot
-                self._queue.popleft()
+                self._queue.discard(req)
                 self._rows_queued -= len(req.rows) - req.offset
                 continue
             take = min(len(req.rows) - req.offset, self.max_batch - len(rows))
@@ -346,8 +393,10 @@ class MicroBatcher:
                 ttrace.record_child("serve.admission", req.trace,
                                     req.t_submit, now - req.t_submit)
             req.offset += take
-            if req.offset >= len(req.rows):
-                self._queue.popleft()
+            self._queue.charge(req, take)
+        if not rows:
+            self._depth.set(len(self._queue))
+            return None
         n = len(rows)
         self._rows_queued -= n
         self._depth.set(len(self._queue))

@@ -72,6 +72,7 @@ from tensorflowonspark_tpu.serving.batcher import (
     MicroBatcher,
     ServeClosed,
     ServeQueueFull,
+    ServeThrottled,
     ServeTimeout,
 )
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int
@@ -411,8 +412,14 @@ class ReactorFrontend:
         """Bulk-admit one read pass's predict frames: ONE batcher critical
         section for the whole pipelined burst."""
         out = self._batcher.submit_many(admissions)
-        for (_rows, deadline, _cb), rid, res in zip(admissions, rids, out):
-            if isinstance(res, ServeQueueFull):
+        for (_rows, deadline, _cb, _tenant), rid, res in zip(
+                admissions, rids, out):
+            if isinstance(res, ServeThrottled):
+                # per-tenant rejection (429): THIS tenant is over budget;
+                # the queue may be nowhere near full for everyone else
+                self._queue_reply(conn, self._err_reply(
+                    "throttled", str(res), rid))
+            elif isinstance(res, ServeQueueFull):
                 self._queue_reply(conn, self._err_reply(
                     "unavailable", str(res), rid))
             elif isinstance(res, ServeClosed):
@@ -440,6 +447,10 @@ class ReactorFrontend:
                            if len(msg) > 2 and msg[2] is not None
                            else self._default_timeout)
                 rows = list(msg[1])
+                # optional tenant key (v3 field; legacy 3/4-tuple frames —
+                # and v2 peers that omit it — land on the anonymous tenant)
+                tenant = (str(msg[4]) if len(msg) > 4 and msg[4] is not None
+                          else "")
             except (TypeError, ValueError) as e:
                 raise ProtocolError(f"bad predict frame: {e}") from e
             if timeout != timeout or timeout == float("inf"):
@@ -463,7 +474,8 @@ class ReactorFrontend:
             deadline = _monotonic() + timeout
             admissions.append((rows, deadline,
                                lambda r, c=conn, i=rid:
-                               self._request_done(c, r, i)))
+                               self._request_done(c, r, i),
+                               tenant))
             rids.append(rid)
         elif op == "ping":
             rid = msg[1] if len(msg) > 1 else None
@@ -504,7 +516,8 @@ class ReactorFrontend:
         err = req.error
         if err is None:
             return (rid, "ok", req.results)
-        kind = ("unavailable" if isinstance(err, ServeQueueFull)
+        kind = ("throttled" if isinstance(err, ServeThrottled)
+                else "unavailable" if isinstance(err, ServeQueueFull)
                 else "deadline" if isinstance(err, ServeTimeout)
                 else "closed" if isinstance(err, ServeClosed)
                 else "internal")

@@ -29,18 +29,37 @@ Hot reload: a version watcher polls ``export_dir``; when a newer export
 lands, in-flight batches drain, every replica swaps its bundle via a
 control round (``serving_loop`` + ``checkpoint.invalidate_bundle``), and
 dispatch resumes — requests keep queuing during the swap.
+
+Staged rollouts (ISSUE 16): ``rollout(export_dir, ...)`` replaces the
+stop-the-world swap with a supervised one — load the candidate bundle on
+a canary cohort only (signature-verified targeted control round), split
+``canary_pct`` of live traffic onto it, optionally shadow-mirror primary
+batches for output diffing, and let a :class:`~.rollout.RolloutGovernor`
+watch the canary's error rate / NaN rate / divergence / p99 against the
+primary baseline over a sliding window.  A healthy window promotes
+(fleet-wide verified swap, laggards quarantined until converged); a
+regression auto-rolls the canaries back to the prior export.  Every state
+transition is journaled through the coordinator's rollout registry, so a
+control-plane failover restores what was in flight.
+
+Per-tenant fairness: requests may carry a tenant key (``predict(...,
+tenant=...)``; v2/v3 frames carry it on the wire, legacy id-less clients
+land in the anonymous tenant).  Admission runs per-tenant token buckets
+and weighted DRR queues with a brownout ladder instead of one cliff —
+see ``serving/tenancy.py``.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
-import os
+import math
 import threading
 from time import monotonic as _monotonic
 from typing import Any, Sequence
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.checkpoint import bundle_signature
 from tensorflowonspark_tpu.telemetry import trace as ttrace
 from tensorflowonspark_tpu.dataserver import _recv, _send
 from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401 - CTL_KEY re-exported
@@ -49,9 +68,11 @@ from tensorflowonspark_tpu.serving.batcher import (  # noqa: F401 - CTL_KEY re-e
     PendingPrediction,
     ServeClosed,
     ServeQueueFull,
+    ServeThrottled,
     ServeTimeout,
 )
 from tensorflowonspark_tpu.serving.frontend import ReactorFrontend
+from tensorflowonspark_tpu.serving.rollout import RolloutGovernor, RolloutState
 from tensorflowonspark_tpu.serving.router import ReplicaRouter
 from tensorflowonspark_tpu.utils.envtune import env_float, env_int
 from tensorflowonspark_tpu.utils.net import (
@@ -60,12 +81,11 @@ from tensorflowonspark_tpu.utils.net import (
     hmac_handshake_client,
     local_ip,
 )
-from tensorflowonspark_tpu.utils.paths import resolve_uri
 
 logger = logging.getLogger(__name__)
 
 _ERR_TYPES = {"unavailable": ServeQueueFull, "deadline": ServeTimeout,
-              "closed": ServeClosed}
+              "closed": ServeClosed, "throttled": ServeThrottled}
 
 
 class ServingGateway:
@@ -85,8 +105,10 @@ class ServingGateway:
                  listen: bool = True, listen_host: str = "",
                  handshake_timeout: float | None = None,
                  max_conn_outstanding: int | None = None,
-                 reload_poll_secs: float = 2.0):
+                 reload_poll_secs: float = 2.0,
+                 tenant_weights: dict[str, float] | None = None):
         self.export_dir = export_dir
+        self._cluster = cluster
         self.max_batch = (int(max_batch) if max_batch is not None
                           else env_int("TOS_SERVE_MAX_BATCH", 64))
         delay_ms = (float(max_delay_ms) if max_delay_ms is not None
@@ -105,6 +127,7 @@ class ServingGateway:
         self._closed = False
         self._reloading = False
         self._reload_lock = threading.Lock()
+        self._rollout: RolloutGovernor | None = None
         self._router = ReplicaRouter(cluster, None,  # batcher set just below
                                      qname_in=qname_in, qname_out=qname_out,
                                      request_timeout=self.default_timeout)
@@ -112,13 +135,14 @@ class ServingGateway:
             self._router.submit, max_batch=self.max_batch,
             max_delay_secs=delay_ms / 1e3, queue_limit=self.queue_limit,
             pause_fn=lambda: self._reloading,
-            capacity_fn=self._router.has_capacity)
+            capacity_fn=self._router.has_capacity,
+            tenant_weights=tenant_weights)
         self._router._batcher = self._batcher
         # version watch: swap in a newer export, draining in-flight first
+        self._export_sig = self._export_signature()
         self._watch_stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
         if reload_poll_secs and reload_poll_secs > 0:
-            self._export_sig = self._export_signature()
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, args=(float(reload_poll_secs),),
                 daemon=True, name="serve-version-watch")
@@ -152,23 +176,30 @@ class ServingGateway:
         """(host, port) of the TCP frontend (None when ``listen=False``)."""
         return self._endpoint
 
-    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+    def predict(self, rows: Sequence[Any], timeout: float | None = None,
+                tenant: str | None = None) -> list:
         """Score ``rows``; returns one result per row, in order.
 
         Raises :class:`ServeQueueFull` when admission control rejects the
-        request (queue full — the 503), :class:`ServeTimeout` when the
-        deadline (``timeout``, default ``TOS_SERVE_TIMEOUT``) expires first,
-        and :class:`ServeClosed` after shutdown.
+        request (queue full — the 503), :class:`ServeThrottled` when the
+        request's *tenant* is over its rate limit or brownout share (the
+        429 — other tenants are still being served), :class:`ServeTimeout`
+        when the deadline (``timeout``, default ``TOS_SERVE_TIMEOUT``)
+        expires first, and :class:`ServeClosed` after shutdown.
         """
-        return self.predict_async(rows, timeout).result()
+        return self.predict_async(rows, timeout, tenant).result()
 
     def predict_async(self, rows: Sequence[Any],
-                      timeout: float | None = None) -> PendingPrediction:
-        """Admit one request and return immediately; ``result()`` blocks."""
+                      timeout: float | None = None,
+                      tenant: str | None = None) -> PendingPrediction:
+        """Admit one request and return immediately; ``result()`` blocks.
+        ``tenant`` scopes fairness (queues, rate limits, brownout shares);
+        omitted means the anonymous tenant."""
         deadline = _monotonic() + (timeout if timeout is not None
                                    else self.default_timeout)
-        return PendingPrediction(self._batcher,
-                                 self._batcher.submit(rows, deadline))
+        return PendingPrediction(
+            self._batcher,
+            self._batcher.submit(rows, deadline, tenant or ""))
 
     def healthy_replicas(self) -> list[int]:
         return self._router.healthy_replicas()
@@ -177,6 +208,14 @@ class ServingGateway:
         """Per-replica outstanding batches (the router's routing signal) —
         what autoscaling victim selection reads."""
         return self._router.replica_loads()
+
+    def shed_level(self) -> int:
+        """Current brownout rung (0 = normal; see ``TOS_SERVE_SHED_LADDER``)."""
+        return self._batcher.shed_level()
+
+    def tenant_depths(self) -> dict[str, int]:
+        """Queued requests per tenant (nonzero only)."""
+        return self._batcher.tenant_depths()
 
     # -- elastic membership (driven by cluster.resize) -----------------------
 
@@ -198,13 +237,21 @@ class ServingGateway:
         pause dispatch, drain in-flight batches, round-trip the reload
         control item through each replica, resume.  Returns per-replica
         acks.  Called automatically by the version watcher; safe to call
-        by hand after an in-place re-export."""
+        by hand after an in-place re-export.  Refused while a staged
+        rollout is in flight — a fleet-wide swap would clobber the canary
+        cohort's candidate bundle under the governor."""
         with self._reload_lock:
+            if self._rollout is not None and self._rollout.active():
+                raise RuntimeError(
+                    "a staged rollout is in flight; wait for it to resolve "
+                    "(or roll it back) before a fleet-wide reload")
             self._reloading = True
             try:
                 self._router.drain()
-                acks = self._router.broadcast_ctl(
-                    {CTL_KEY: "reload", "export_dir": self.export_dir})
+                ctl = {CTL_KEY: "reload", "export_dir": self.export_dir}
+                acks = self._router.broadcast_ctl(ctl)
+                self._quarantine_laggards(
+                    acks, bundle_signature(self.export_dir), ctl)
                 telemetry.counter("serve.reloads_total").inc()
                 ttrace.event("reload", export_dir=self.export_dir,
                              replicas=sorted(acks))
@@ -214,19 +261,29 @@ class ServingGateway:
             finally:
                 self._reloading = False
 
+    def _quarantine_laggards(self, acks: dict[int, Any], want: tuple,
+                             ctl: dict) -> list[int]:
+        """The mixed-fleet guard: every replica whose reload ack does not
+        carry the expected bundle signature is fenced out of routing with
+        the ctl pinned for recovery replay (``quarantine_for_reload``), so
+        a half-applied swap can never keep silently serving the stale
+        bundle next to the converged fleet.  (Replicas that failed the
+        round outright were already fenced + pinned by the broadcast.)"""
+        laggards = [eid for eid, ack in acks.items()
+                    if not (isinstance(ack, dict)
+                            and tuple(ack.get("signature") or ()) == want)]
+        for eid in laggards:
+            logger.warning("serving replica %d acked the reload with the "
+                           "wrong bundle signature; quarantined until "
+                           "recovery converges it", eid)
+            self._router.quarantine_for_reload(eid, ctl)
+        return laggards
+
     def _export_signature(self) -> tuple:
-        """Cheap change signature of the export: (name, mtime_ns, size) of
-        the bundle files.  ``export_bundle`` commits params.npz by atomic
-        rename, so a changed signature is a complete newer export."""
-        local = resolve_uri(self.export_dir)
-        sig = []
-        for name in ("bundle.json", "params.npz", "params"):
-            try:
-                st = os.stat(os.path.join(local, name))
-            except OSError:
-                continue
-            sig.append((name, st.st_mtime_ns, st.st_size))
-        return tuple(sig)
+        """Change signature of the active export (see
+        ``checkpoint.bundle_signature``): a changed tuple is a complete
+        newer export, thanks to the atomic-rename commit."""
+        return bundle_signature(self.export_dir)
 
     def _watch_loop(self, poll: float) -> None:
         while not self._watch_stop.wait(poll):
@@ -247,7 +304,166 @@ class ServingGateway:
                                    "previous bundle (will retry)",
                                    exc_info=True)
                 else:
-                    self._export_sig = cur
+                    # under the reload lock: a promotion updates the active
+                    # signature too, and the two must not interleave
+                    with self._reload_lock:
+                        self._export_sig = cur
+
+    # -- staged rollouts (shadow/canary + governed promote/rollback) ---------
+
+    def rollout(self, export_dir: str, *, canary_pct: int | None = None,
+                shadow: bool | int = True,
+                window_secs: float | None = None,
+                auto_promote: bool = True,
+                **governor_kwargs) -> RolloutGovernor:
+        """Stage the bundle at ``export_dir`` as a rollout CANDIDATE
+        instead of swapping the fleet onto it.
+
+        Mechanics: pause + drain, load the candidate on a canary cohort
+        (``canary_pct`` percent of the healthy replicas, at least one,
+        never all) via a targeted signature-verified control round, then
+        resume with split routing — every ``100/canary_pct``-th batch
+        rides the canary, and with ``shadow`` enabled primary batches are
+        mirrored onto it (every Nth when ``shadow`` is an int) so the
+        governor can diff candidate outputs against primary answers that
+        were already served.  The returned :class:`RolloutGovernor` then
+        watches the canary for ``window_secs`` (default
+        ``TOS_SERVE_ROLLOUT_WINDOW_SECS``) and promotes fleet-wide or
+        auto-rolls the canaries back; ``.wait()`` blocks for the outcome,
+        ``.status()`` is the live picture.  The in-flight state is
+        journaled in the coordinator's rollout registry.
+        """
+        if self._closed:
+            raise ServeClosed("serving gateway is closed")
+        pct = (int(canary_pct) if canary_pct is not None
+               else env_int("TOS_SERVE_CANARY_PCT", 25))
+        if not 0 < pct <= 100:
+            raise ValueError("canary_pct must be in (0, 100]")
+        want = bundle_signature(export_dir)
+        if not want:
+            raise ValueError(f"no exported bundle found at {export_dir!r}")
+        ctl = {CTL_KEY: "reload", "export_dir": export_dir,
+               "candidate": True}
+        with self._reload_lock:
+            if self._rollout is not None and self._rollout.active():
+                raise RuntimeError("a staged rollout is already in flight")
+            healthy = self._router.healthy_replicas()
+            if len(healthy) < 2:
+                raise RuntimeError(
+                    "staged rollout needs >= 2 healthy replicas (one must "
+                    "keep serving primary traffic); use reload() on a "
+                    "single-replica fleet")
+            # deterministic cohort: lowest executor ids — stable across
+            # retries and reconstructable from the journaled state
+            n = max(1, min(len(healthy) - 1,
+                           math.ceil(len(healthy) * pct / 100)))
+            canary = healthy[:n]
+            self._reloading = True
+            try:
+                self._router.drain()
+                acks = self._router.ctl_to(canary, ctl)
+                laggards = set(self._quarantine_laggards(acks, want, ctl))
+                cohort = [eid for eid in canary
+                          if eid in acks and eid not in laggards]
+                if not cohort:
+                    raise RuntimeError(
+                        f"no canary replica loaded the candidate bundle "
+                        f"from {export_dir!r}; rollout aborted "
+                        f"(fleet unchanged)")
+                mirror_every = (0 if not shadow
+                                else 1 if shadow is True else max(1, int(shadow)))
+                state = RolloutState(candidate=export_dir,
+                                     prior=self.export_dir, canary=cohort,
+                                     pct=pct, shadow=bool(shadow))
+                governor = RolloutGovernor(
+                    self, state, window_secs=window_secs,
+                    auto_promote=auto_promote, **governor_kwargs)
+                self._router.set_rollout(
+                    cohort,
+                    traffic_every=max(1, round(100 / pct)),
+                    mirror_every=mirror_every,
+                    observer=governor.observe,
+                    canary_ctl=ctl,
+                    shed_fn=self._batcher.shed_level)
+                self._rollout = governor
+            finally:
+                self._reloading = False
+        telemetry.counter("serve.rollouts_total").inc()
+        ttrace.event("rollout_started", candidate=export_dir,
+                     canary=cohort, pct=pct, shadow=bool(shadow))
+        logger.info("staged rollout of %s: canary cohort %s (%d%% traffic"
+                    "%s)", export_dir, cohort, pct,
+                    ", shadow mirroring" if mirror_every else "")
+        self._note_rollout(state.payload())
+        governor.start()
+        return governor
+
+    def rollout_status(self) -> dict | None:
+        """The current (or last) rollout's live status dict, or None if
+        this gateway never staged one."""
+        gov = self._rollout
+        return None if gov is None else gov.status()
+
+    def _promote_rollout(self, governor: RolloutGovernor) -> None:
+        """Governor callback: the canary window stayed clean — swap the
+        WHOLE fleet onto the candidate via the verified reload path and
+        end the split.  The candidate becomes the gateway's active
+        ``export_dir`` (the version watcher now tracks it)."""
+        candidate = governor.state.candidate
+        want = bundle_signature(candidate)
+        with self._reload_lock:
+            self._reloading = True
+            try:
+                self._router.drain()
+                # no `candidate` bit: post-promotion this is the active
+                # bundle everywhere (bad_model chaos stops firing too)
+                ctl = {CTL_KEY: "reload", "export_dir": candidate}
+                acks = self._router.broadcast_ctl(ctl)
+                self._quarantine_laggards(acks, want, ctl)
+                self._router.clear_rollout()
+                self.export_dir = candidate
+                self._export_sig = want
+            finally:
+                self._reloading = False
+        telemetry.counter("serve.promotions_total").inc()
+        ttrace.event("rollout_promoted", candidate=candidate,
+                     replicas=sorted(acks))
+        logger.info("rollout promoted: fleet now serving %s", candidate)
+
+    def _rollback_rollout(self, governor: RolloutGovernor,
+                          reason: str | None) -> None:
+        """Governor callback: the canary regressed — reload the canary
+        cohort back onto the prior export and end the split.  Primary
+        replicas never touched the candidate, so they need nothing."""
+        state = governor.state
+        ctl = {CTL_KEY: "reload", "export_dir": state.prior}
+        with self._reload_lock:
+            self._reloading = True
+            try:
+                self._router.drain()
+                acks = self._router.ctl_to(state.canary, ctl)
+                self._quarantine_laggards(acks, bundle_signature(state.prior),
+                                          ctl)
+                self._router.clear_rollout()
+            finally:
+                self._reloading = False
+        telemetry.counter("serve.rollbacks_total").inc()
+        ttrace.event("rollout_rolled_back", candidate=state.candidate,
+                     reason=reason, replicas=sorted(acks))
+        logger.warning("rollout of %s rolled back: %s", state.candidate,
+                       reason)
+
+    def _note_rollout(self, payload: dict) -> None:
+        """Best-effort journal of the rollout state through the
+        coordinator's rollout registry (keyed by this gateway's router
+        name) — failover/statz evidence, never allowed to break serving."""
+        coord = getattr(self._cluster, "coordinator", None)
+        if coord is None or not hasattr(coord, "note_rollout"):
+            return
+        try:
+            coord.note_rollout(self._router._registry_name, payload)
+        except Exception:  # noqa: BLE001 - journal publish must not break serving
+            logger.debug("rollout journal publish failed", exc_info=True)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -260,6 +476,11 @@ class ServingGateway:
         self._watch_stop.set()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=10.0)
+        if self._rollout is not None:
+            # journal the abort before the layers come down: the registry
+            # must not read "canary" forever off a gateway that is gone
+            with contextlib.suppress(Exception):
+                self._rollout.stop()
         # router + batcher first: closing them resolves every request (the
         # last completion producers), so the frontend's reactor — still
         # draining — delivers the final error replies, and stop() can then
@@ -340,7 +561,7 @@ class GatewayClient:
 
     def __init__(self, host: str, port: int, authkey: bytes, *,
                  connect_timeout: float = 30.0, call_timeout: float = 120.0,
-                 max_outstanding: int = 0):
+                 max_outstanding: int = 0, tenant: str | None = None):
         self._sock = connect_with_backoff((host, port),
                                           timeout=connect_timeout)
         self._sock.settimeout(call_timeout)
@@ -348,6 +569,11 @@ class GatewayClient:
             self._sock.close()
             raise RuntimeError("gateway auth handshake failed")
         self._call_timeout = call_timeout
+        # fairness identity: rides every predict frame as a trailing field
+        # (absent for the default "" — byte-identical to the pre-tenant
+        # wire, which is what keeps id-less/legacy clients compatible;
+        # they all land in the anonymous tenant)
+        self._tenant = str(tenant) if tenant else ""
         # reply-reaper backstop past the server-enforced deadline: how much
         # grace an overdue reply gets before the connection is presumed dead
         self._slack = env_float("TOS_SERVE_CLIENT_SLACK", 30.0)
@@ -367,8 +593,11 @@ class GatewayClient:
 
     # -- wire ----------------------------------------------------------------
 
-    def _start(self, msg: tuple, timeout: float) -> _GatewayFuture:
-        """Register a future under a fresh id and send ``msg + (id,)``."""
+    def _start(self, msg: tuple, timeout: float,
+               tail: tuple = ()) -> _GatewayFuture:
+        """Register a future under a fresh id and send ``msg + (id,) +
+        tail`` (``tail`` carries optional post-id fields like the tenant
+        key — old gateways ignore trailing fields they don't know)."""
         if self._sem is not None:
             self._sem.acquire()
         with self._lock:
@@ -382,7 +611,7 @@ class GatewayClient:
             self._pending[rid] = fut
         try:
             with self._send_lock:
-                _send(self._sock, (*msg, rid), wire=2)
+                _send(self._sock, (*msg, rid, *tail), wire=2)
         except (TimeoutError, OSError) as e:
             self._poison(e)
             raise
@@ -462,16 +691,22 @@ class GatewayClient:
     # -- API -----------------------------------------------------------------
 
     def predict_async(self, rows: Sequence[Any],
-                      timeout: float | None = None) -> _GatewayFuture:
+                      timeout: float | None = None,
+                      tenant: str | None = None) -> _GatewayFuture:
         """Send one predict request; returns a future resolved by reply id.
-        Many may be outstanding — that is the point."""
+        Many may be outstanding — that is the point.  ``tenant`` overrides
+        the client's default fairness identity for this request."""
         t = float(timeout) if timeout is not None else self._call_timeout
-        return self._start(("predict", list(rows), timeout), t)
+        ten = self._tenant if tenant is None else str(tenant)
+        return self._start(("predict", list(rows), timeout), t,
+                           (ten,) if ten else ())
 
-    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
+    def predict(self, rows: Sequence[Any], timeout: float | None = None,
+                tenant: str | None = None) -> list:
         """Round-trip one predict request; mirrors ``ServingGateway.predict``
-        including its error types."""
-        return self.predict_async(rows, timeout).result()
+        including its error types (``ServeThrottled`` = this tenant is over
+        its rate limit / brownout share)."""
+        return self.predict_async(rows, timeout, tenant).result()
 
     def outstanding(self) -> int:
         """Requests currently awaiting replies (the pool's load signal)."""
@@ -523,11 +758,13 @@ class GatewayClientPool:
         return min(self._clients, key=lambda c: c.outstanding())
 
     def predict_async(self, rows: Sequence[Any],
-                      timeout: float | None = None) -> _GatewayFuture:
-        return self._pick().predict_async(rows, timeout)
+                      timeout: float | None = None,
+                      tenant: str | None = None) -> _GatewayFuture:
+        return self._pick().predict_async(rows, timeout, tenant)
 
-    def predict(self, rows: Sequence[Any], timeout: float | None = None) -> list:
-        return self.predict_async(rows, timeout).result()
+    def predict(self, rows: Sequence[Any], timeout: float | None = None,
+                tenant: str | None = None) -> list:
+        return self.predict_async(rows, timeout, tenant).result()
 
     def ping(self) -> bool:
         return all(c.ping() for c in self._clients)

@@ -13,8 +13,15 @@ Latency properties:
 - control items (``{CTL_KEY: "reload"}``) ride the same stream as one-item
   rounds and are acked with a one-item result, so the exactly-count
   transport invariant holds for them too.  A ``reload`` invalidates the
-  bundle cache entry and reloads from ``export_dir`` — the node half of
-  the gateway's hot swap.
+  bundle cache entry and reloads — the node half of the gateway's hot
+  swap.  The control item may carry its own ``export_dir`` (the staged-
+  rollout primitive: a canary replica switches to the CANDIDATE bundle's
+  directory while the rest of the fleet stays on the boot export) and a
+  ``candidate`` bit marking the loaded bundle as a rollout candidate (the
+  ``bad_model`` chaos hook fires only then).  The ack echoes the active
+  export_dir plus its on-disk bundle signature, so the gateway can verify
+  every cohort member actually converged on the bundle it asked for — a
+  replica acking a different signature is a promotion laggard.
 
 Termination is the standard feed contract: EOF (cluster shutdown) or the
 driver's stop signal ends the loop; a supervised restart simply re-enters
@@ -22,6 +29,8 @@ it, loading whatever bundle is newest on disk.
 """
 
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -35,7 +44,9 @@ def serving_loop(args, ctx) -> None:
     ``postprocess`` ("argmax" for int class ids), ``input_mapping``
     (row-dict column selection, see ``inference.rows_to_features``).
     """
+    from tensorflowonspark_tpu import faultinject
     from tensorflowonspark_tpu.checkpoint import (
+        bundle_signature,
         invalidate_bundle,
         load_bundle_cached,
     )
@@ -54,6 +65,10 @@ def serving_loop(args, ctx) -> None:
     input_mapping = _arg(args, "input_mapping")
 
     variables, _config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    # staged-rollout state: True while this replica serves a rollout
+    # CANDIDATE bundle (set by the reload ctl's `candidate` bit) — the
+    # bad_model chaos hook only ever corrupts candidate output
+    serving_candidate = False
     batches = ctx.metrics.counter("serve.node_batches")
     rows_served = ctx.metrics.counter("serve.node_rows")
     feed = ctx.get_data_feed(train_mode=False)
@@ -64,11 +79,20 @@ def serving_loop(args, ctx) -> None:
         if len(items) == 1 and isinstance(items[0], dict) and CTL_KEY in items[0]:
             op = items[0][CTL_KEY]
             if op == "reload":
+                # the ctl may redirect this replica to a DIFFERENT export
+                # (canary load / rollback); a plain reload re-reads the
+                # active one
+                export_dir = str(items[0].get("export_dir") or export_dir)
+                serving_candidate = bool(items[0].get("candidate"))
                 invalidate_bundle(export_dir)
                 variables, _config, apply_fn = load_bundle_cached(
                     export_dir, build_apply)
                 ctx.metrics.counter("serve.node_reloads").inc()
-                feed.batch_results([{CTL_KEY: "reloaded"}])
+                # echo dir + on-disk signature: the gateway verifies every
+                # cohort member converged on the bundle it asked for
+                feed.batch_results([{CTL_KEY: "reloaded",
+                                     "export_dir": export_dir,
+                                     "signature": bundle_signature(export_dir)}])
             elif op == "ping":
                 # echo the nonce: the router's re-admission resync matches
                 # ITS pong (inputs are processed in order, so everything
@@ -90,6 +114,18 @@ def serving_loop(args, ctx) -> None:
                             parent=getattr(feed, "last_trace", None)):
             x = rows_to_features(padded, input_mapping)
             out = apply_fn(variables, x)
+            corrupt, delay = faultinject.bad_model(serving_candidate)
+            if delay:
+                time.sleep(delay)
+            if corrupt:
+                # injected model regression: candidate outputs go NaN —
+                # the rollout governor must catch this, never the clients
+                # of primary replicas
+                out = ({k: np.full_like(np.asarray(v, dtype=float),
+                                        np.nan) for k, v in out.items()}
+                       if isinstance(out, dict)
+                       else np.full_like(np.asarray(out, dtype=float),
+                                         np.nan))
         if isinstance(out, dict):
             if postprocess == "argmax":
                 raise ValueError("postprocess='argmax' needs a single-output "

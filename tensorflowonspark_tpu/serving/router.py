@@ -31,6 +31,19 @@ Hot reload support: ``drain()`` blocks until no batch is queued or in
 flight, and ``broadcast_ctl()`` round-trips a control item (e.g. the
 ``serving_loop`` reload command) through every healthy replica while the
 workers are idle.
+
+Staged rollouts (ISSUE 16): ``set_rollout`` splits the fleet into a
+primary and a canary cohort.  Routing becomes cohort-aware — every
+``traffic_every``-th batch rides the canary, every ``mirror_every``-th
+successful primary batch is cloned onto the canary as a no-waiter shadow
+mirror carrying the primary's results for output diffing, and every batch
+outcome (cohort, latency, results, transport error) feeds the rollout
+governor's observer.  Live traffic never depends on the canary: a canary
+batch with no healthy canary falls back to primary, a failed canary
+attempt retries on primary, and mirrors are dropped (shed first under
+brownout).  ``ctl_to`` targets control rounds at one cohort, and the
+cohort's reload ctl is remembered so a canary that dies mid-rollout is
+converged back onto the candidate bundle before re-admission.
 """
 
 from __future__ import annotations
@@ -56,7 +69,7 @@ logger = logging.getLogger(__name__)
 class _Replica:
     __slots__ = ("executor_id", "queue", "inflight", "healthy", "client",
                  "client_inc", "pending_ctl", "thread", "last_pick",
-                 "draining", "retired")
+                 "draining", "retired", "cohort")
 
     def __init__(self, executor_id: int):
         self.executor_id = executor_id
@@ -70,6 +83,11 @@ class _Replica:
         self.pending_ctl: dict | None = None
         self.thread: threading.Thread | None = None
         self.last_pick = 0
+        # rollout cohort: "primary" outside a rollout; the canary members
+        # of an active rollout carry "canary" (the object outlives the
+        # replica PROCESS, so a SIGKILLed canary's restart rejoins the
+        # same cohort — recovery replays the cohort's reload ctl first)
+        self.cohort = "primary"
         # scale-in lifecycle (retire_replica): a DRAINING replica finishes
         # its queued/in-flight batches but is never picked for new ones;
         # RETIRED tells its worker thread to exit once the queue is empty
@@ -110,6 +128,16 @@ class ReplicaRouter:
         self._stop = False
         self._pick_seq = 0
         self._resync_seq = 0  # recovery-thread only; nonces for _resync
+        # rollout state (set_rollout/clear_rollout): deterministic traffic
+        # split + shadow mirroring + the per-batch outcome observer the
+        # rollout governor feeds on.  All mutated under _cond.
+        self._batch_seq = 0
+        self._mirror_seq = 0
+        self._canary_every = 0   # every Nth batch routes to canary (0=off)
+        self._mirror_every = 0   # every Nth primary batch mirrored (0=off)
+        self._observer = None    # fn(cohort, eid, ok, secs, results, error, mirror_of)
+        self._cohort_ctl: dict[str, dict] = {}  # cohort -> reload ctl for recovery
+        self._shed_fn = lambda: 0  # batcher brownout level (sheds mirrors)
         self._replicas: dict[int, _Replica] = {
             eid: _Replica(eid) for eid in cluster._feed_ids}
         # journal-backed serving registry (ISSUE 13): this router's healthy
@@ -151,21 +179,47 @@ class ReplicaRouter:
     # -- dispatch ------------------------------------------------------------
 
     def submit(self, batch: MicroBatch, exclude: int | None = None) -> None:
-        """Queue the batch on the least-outstanding healthy replica; a batch
-        that finds no healthy replica fails its waiters immediately."""
+        """Queue the batch on the least-outstanding healthy replica of its
+        cohort; a batch that finds no healthy replica fails its waiters
+        immediately.  Cohort selection: a fresh batch is assigned here
+        (every ``canary_every``-th batch rides the canary during a live
+        split); a canary batch with no healthy canary replica falls back
+        to primary — live traffic must never fail because the canary
+        cohort is down — while a shadow MIRROR (nothing waits on it) is
+        simply dropped."""
         with self._cond:
-            target = None if self._stop else self._pick_locked(exclude)
+            if batch.cohort is None:
+                batch.cohort = self._choose_cohort_locked()
+            target = None if self._stop else self._pick_locked(
+                exclude, batch.cohort)
+            if target is None and batch.cohort == "canary" and not self._stop:
+                if batch.mirror_of is not None:
+                    telemetry.counter("serve.shadow_dropped").inc()
+                    return
+                batch.cohort = "primary"
+                target = self._pick_locked(exclude, "primary")
             if target is not None:
                 target.queue.append(batch)
                 self._update_outstanding_locked()
                 self._cond.notify_all()
                 return
+        if batch.mirror_of is not None:
+            return  # mirrors carry no waiters; nothing to fail
         self._batcher.fail_batch(batch, RuntimeError(
             "no healthy serving replica available"))
 
-    def _pick_locked(self, exclude: int | None) -> _Replica | None:
+    def _choose_cohort_locked(self) -> str:
+        if not self._canary_every:
+            return "primary"
+        self._batch_seq += 1
+        return ("canary" if self._batch_seq % self._canary_every == 0
+                else "primary")
+
+    def _pick_locked(self, exclude: int | None,
+                     cohort: str = "primary") -> _Replica | None:
         live = [r for r in self._replicas.values()
-                if r.healthy and not r.draining and r.executor_id != exclude]
+                if r.healthy and not r.draining and r.executor_id != exclude
+                and r.cohort == cohort]
         if not live:
             return None
         # least-outstanding, ties broken least-recently-picked: a fixed
@@ -191,10 +245,15 @@ class ReplicaRouter:
         queue, which costs one completion-notify wakeup but keeps the
         least-outstanding choice as late (= as informed) as possible.
         With NO healthy replica it returns True so batches flush and fail
-        fast instead of silently aging out on their deadlines."""
+        fast instead of silently aging out on their deadlines.  Only the
+        PRIMARY cohort counts: during a shadow rollout the canary replicas
+        sit idle between mirrors, and letting their idleness trigger
+        partial flushes would re-create the small-batch convoy on the
+        primaries that actually serve the traffic."""
         with self._cond:
             live = [r for r in self._replicas.values()
-                    if r.healthy and not r.draining]
+                    if r.healthy and not r.draining
+                    and r.cohort == "primary"]
             if not live:
                 return True
             return any(_load(r) == 0 for r in live)
@@ -233,6 +292,7 @@ class ReplicaRouter:
                 ttrace.record_child(
                     "serve.batch_fill", batch.trace, batch.created_at,
                     _monotonic() - batch.created_at)
+            t0 = _monotonic()
             try:
                 client = self._client_for(rep)
                 with telemetry.timed("serve.batch_secs"), \
@@ -250,8 +310,13 @@ class ReplicaRouter:
                     rerouted = self._mark_unhealthy_locked(rep)
                 self._update_outstanding_locked()
                 self._cond.notify_all()
+            self._observe(batch, rep, error, _monotonic() - t0, results)
             if error is None:
-                self._batcher.complete_batch(batch, results)
+                if batch.mirror_of is None:
+                    self._batcher.complete_batch(batch, results)
+                    self._maybe_mirror(batch, results)
+                # a mirror's results went to the observer (output diff);
+                # nothing waits on the batch itself
                 continue
             logger.warning("serving replica %d failed a batch: %s",
                            rep.executor_id, error)
@@ -259,7 +324,48 @@ class ReplicaRouter:
                 # never attempted on this replica: re-route without
                 # spending the queued batch's one retry
                 self.submit(queued, exclude=rep.executor_id)
+            if batch.mirror_of is not None:
+                continue  # a failed mirror is dropped, never retried
             self._retry(batch, rep.executor_id, error)
+
+    def _observe(self, batch: MicroBatch, rep: _Replica,
+                 error: Exception | None, secs: float,
+                 results: list | None) -> None:
+        """Feed one batch outcome to the rollout observer (never on the
+        lock, never allowed to break serving).  The observer owns the
+        canary-vs-primary bookkeeping — error classification (an exception
+        HERE is transport/infra, e.g. a dead replica, and must not count
+        as model regression), latency windows, NaN/divergence scans."""
+        obs = self._observer
+        if obs is None:
+            return
+        try:
+            obs(batch.cohort or "primary", rep.executor_id, error is None,
+                secs, results, error, batch.mirror_of)
+        except Exception:  # noqa: BLE001 - rollout bookkeeping must not break serving
+            logger.debug("rollout observer failed", exc_info=True)
+
+    def _maybe_mirror(self, batch: MicroBatch, results: list) -> None:
+        """Shadow sampling: clone every ``mirror_every``-th successful
+        PRIMARY batch onto the canary cohort, carrying the primary's
+        results for the observer's output diff.  Mirrors have no entries —
+        no client ever waits on one — and are the FIRST traffic shed under
+        brownout (ladder level 1)."""
+        if (self._mirror_every <= 0 or batch.cohort != "primary"
+                or batch.mirror_of is not None):
+            return
+        if self._shed_fn() >= 1:
+            telemetry.counter("serve.shadow_shed").inc()
+            return
+        with self._cond:
+            self._mirror_seq += 1
+            if self._mirror_seq % self._mirror_every:
+                return
+        mirror = MicroBatch(batch.rows, batch.n, [])
+        mirror.cohort = "canary"
+        mirror.mirror_of = results
+        telemetry.counter("serve.shadow_mirrors").inc()
+        self.submit(mirror)
 
     def _retry(self, batch: MicroBatch, failed_eid: int,
                error: Exception) -> None:
@@ -270,6 +376,10 @@ class ReplicaRouter:
                          error=str(error)[:200])
             logger.warning("retrying in-flight batch from dead replica %d "
                            "on a live replica", failed_eid)
+            # a failed canary attempt retries on the PRIMARY cohort: the
+            # request's answer must never depend on the canary staying up
+            if batch.cohort == "canary":
+                batch.cohort = "primary"
             self.submit(batch, exclude=failed_eid)
             return
         wrapped = RuntimeError(
@@ -360,7 +470,13 @@ class ReplicaRouter:
         except Exception:  # noqa: BLE001 - port dark mid-restart
             return False
         with self._cond:
-            pending = rep.pending_ctl  # snapshot; re-checked at admission
+            pinned = rep.pending_ctl  # snapshot; re-checked at admission
+            # during a rollout the replica's COHORT pins a reload ctl too
+            # (set_rollout): a SIGKILLed canary's restart boots from the
+            # ORIGINAL export_dir, so recovery must replay the cohort's
+            # candidate reload or the "canary" would silently serve the
+            # primary bundle and poison the governor's comparison
+            pending = pinned or self._cohort_ctl.get(rep.cohort)
         try:
             if not self._resync(client):
                 raise RuntimeError("resync did not complete in time")
@@ -377,7 +493,7 @@ class ReplicaRouter:
                 client.close()
             return False
         with self._cond:
-            if rep.pending_ctl is not None and rep.pending_ctl != pending:
+            if rep.pending_ctl is not None and rep.pending_ctl != pinned:
                 # a reload broadcast pinned a NEWER ctl while this recovery
                 # was in flight: admitting now would serve the old bundle —
                 # bail and let the next pass replay it
@@ -497,6 +613,104 @@ class ReplicaRouter:
                         self._mark_unhealthy_locked(rep)
                         rep.pending_ctl = dict(item)
         return acks
+
+    def ctl_to(self, executor_ids, item: dict,
+               timeout: float = 60.0) -> dict[int, Any]:
+        """``broadcast_ctl`` restricted to a replica subset — the staged-
+        rollout primitive (load the candidate on the canary cohort only;
+        roll just the canaries back).  Same contract: call only paused +
+        drained; a target that fails the round is fenced unhealthy with a
+        ``reload`` item pinned as its ``pending_ctl`` so recovery replays
+        it before re-admission."""
+        acks: dict[int, Any] = {}
+        with self._cond:
+            targets = [r for eid in executor_ids
+                       if (r := self._replicas.get(eid)) is not None
+                       and r.healthy]
+        for rep in targets:
+            try:
+                client = self._client_for(rep)
+                acks[rep.executor_id] = client.infer_round(
+                    [item], self.qname_in, self.qname_out)[0]
+            except Exception as e:  # noqa: BLE001 - replica fenced below
+                logger.warning("control round to serving replica %d failed: "
+                               "%s", rep.executor_id, e)
+                with self._cond:
+                    self._mark_unhealthy_locked(rep)
+        if item.get(CTL_KEY) == "reload":
+            with self._cond:
+                for eid in executor_ids:
+                    rep = self._replicas.get(eid)
+                    if rep is not None and eid not in acks:
+                        rep.pending_ctl = dict(item)
+        return acks
+
+    def quarantine_for_reload(self, executor_id: int, item: dict) -> None:
+        """Fence one replica out of routing until recovery has replayed
+        ``item`` (a reload ctl) through it — the mixed-fleet guard: a
+        replica whose promotion reload acked the WRONG bundle signature
+        must not keep serving the stale bundle alongside the promoted
+        fleet.  Its queued batches re-route to the survivors."""
+        with self._cond:
+            rep = self._replicas.get(executor_id)
+            if rep is None:
+                return
+            rerouted = self._mark_unhealthy_locked(rep)
+            rep.pending_ctl = dict(item)
+            self._update_outstanding_locked()
+            self._cond.notify_all()
+        telemetry.counter("serve.promotion_laggards").inc()
+        ttrace.event("promotion_laggard", executor=executor_id)
+        for batch in rerouted:
+            self.submit(batch, exclude=executor_id)
+
+    # -- staged rollouts (gateway.rollout) -----------------------------------
+
+    def set_rollout(self, canary_eids, *, traffic_every: int = 0,
+                    mirror_every: int = 0, observer=None,
+                    canary_ctl: dict | None = None,
+                    shed_fn=None) -> None:
+        """Enter a rollout split: replicas in ``canary_eids`` form the
+        canary cohort, every ``traffic_every``-th batch routes to them,
+        every ``mirror_every``-th primary batch is shadow-mirrored, and
+        every batch outcome feeds ``observer`` (the rollout governor).
+        ``canary_ctl`` is the candidate's reload item, remembered per
+        cohort so a canary that dies and restarts mid-rollout is converged
+        back onto the CANDIDATE bundle before it rejoins (see
+        ``_try_recover``)."""
+        eids = set(canary_eids)
+        with self._cond:
+            for rep in self._replicas.values():
+                rep.cohort = "canary" if rep.executor_id in eids \
+                    else "primary"
+            self._batch_seq = 0
+            self._mirror_seq = 0
+            self._canary_every = max(0, int(traffic_every))
+            self._mirror_every = max(0, int(mirror_every))
+            self._observer = observer
+            self._cohort_ctl = ({"canary": dict(canary_ctl)}
+                                if canary_ctl else {})
+            if shed_fn is not None:
+                self._shed_fn = shed_fn
+            self._cond.notify_all()
+
+    def clear_rollout(self) -> None:
+        """Leave the split (promotion or rollback both end here): every
+        replica rejoins the primary cohort, traffic/mirror counters stop,
+        the observer detaches."""
+        with self._cond:
+            for rep in self._replicas.values():
+                rep.cohort = "primary"
+            self._canary_every = 0
+            self._mirror_every = 0
+            self._observer = None
+            self._cohort_ctl = {}
+            self._cond.notify_all()
+
+    def cohort_members(self, cohort: str) -> list[int]:
+        with self._cond:
+            return sorted(r.executor_id for r in self._replicas.values()
+                          if r.cohort == cohort)
 
     def healthy_replicas(self) -> list[int]:
         with self._cond:

@@ -203,6 +203,26 @@ _ALL = (
          "GIL switch interval (ms) the serving frontend sets for the "
          "driver process while the reactor runs; CPython's 5ms default "
          "convoys reactor/batcher/router handoffs (pass 5 to opt out)."),
+    Knob("TOS_SERVE_CANARY_PCT", "int", "25",
+         "Staged rollout default: percent of live traffic routed to the "
+         "canary cohort by gateway.rollout() when canary_pct is not "
+         "passed (shadow rollouts mirror this percent instead)."),
+    Knob("TOS_SERVE_ROLLOUT_WINDOW_SECS", "float", "5",
+         "Rollout governor cadence: sliding-window length (seconds) over "
+         "which canary error-rate/p99/divergence are compared against the "
+         "primary baseline before promote/rollback fires."),
+    Knob("TOS_SERVE_TENANT_RATE", "float", "0 (unlimited)",
+         "Per-tenant admission rate limit: rows/second of token-bucket "
+         "budget per unit of tenant weight (1s of burst capacity); a "
+         "tenant over its bucket gets fast-fail ServeThrottled replies "
+         "while other tenants keep their latency.  0 disables rate "
+         "limiting."),
+    Knob("TOS_SERVE_SHED_LADDER", "str", "0.5,0.8",
+         "Brownout ladder: comma-separated admission-queue occupancy "
+         "fractions at which overload shedding escalates — level 1 pauses "
+         "shadow-mirror traffic, level 2 sheds tenants past their "
+         "weight-proportional queue share (lowest-weight overage first), "
+         "before the queue-full cliff (ServeQueueFull) at 100%."),
     Knob("TOS_SERVE_QUEUE", "int", "256",
          "Serving gateway admission control: max queued (not yet "
          "dispatched) predict requests before fast-fail rejection "

@@ -275,6 +275,16 @@ def test_lock_discipline_covers_collective_modules():
         assert found[0].anchor == "C.unlocked_set@mixed:n", basename
 
 
+def test_lock_discipline_covers_rollout_and_tenancy_modules():
+    """ISSUE 16 satellite: the rollout/tenancy modules joined the threaded
+    set (governor thread vs router workers; batcher-owned queues) — the
+    same race fixture that fires in cluster.py fires there too."""
+    for basename in ("rollout.py", "tenancy.py"):
+        found = lint(_MIXED, f"{PKG}/serving/{basename}", "lock-discipline")
+        assert len(found) == 1, basename
+        assert found[0].anchor == "C.unlocked_set@mixed:n", basename
+
+
 def test_lock_quiet_outside_threaded_modules_and_when_all_locked():
     assert lint(_MIXED, f"{PKG}/models/mnist.py", "lock-discipline") == []
     assert lint(
