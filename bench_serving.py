@@ -326,6 +326,102 @@ def run_trace_compare(gateway, *, request_rows: int, feature_dim: int,
             "on_overhead_pct": round((best_off - best_on) / best_off * 100, 2)}
 
 
+def run_witness_compare(gateway, *, request_rows: int, feature_dim: int,
+                        clients: int, duration: float,
+                        rounds: int = 3) -> dict:
+    """Interleaved TOS_LOCK_WITNESS off/on pairs (the run_trace_compare
+    methodology: alternating cells cancel box drift), best-of-N each side.
+    The off cells measure the production shape — a TosLock with the
+    witness disarmed is one attribute check over the raw primitive, so the
+    off-path's own overhead is structural, not separately measurable here;
+    the on cells carry the full held-set/order-graph/hold-histogram
+    machinery on every serving-path acquire (batcher cond, router cond,
+    gateway locks)."""
+    from tensorflowonspark_tpu.utils import locks
+
+    prev = locks.get_witness()
+    offs: list[float] = []
+    ons: list[float] = []
+    try:
+        for _ in range(rounds):
+            locks.disable_witness()
+            offs.append(run_inprocess(
+                gateway, request_rows=request_rows, feature_dim=feature_dim,
+                clients=clients, duration=duration)["qps"])
+            locks.enable_witness(mode="raise")
+            ons.append(run_inprocess(
+                gateway, request_rows=request_rows, feature_dim=feature_dim,
+                clients=clients, duration=duration)["qps"])
+    finally:
+        if prev is not None:
+            locks.enable_witness(mode=prev.mode)
+        else:
+            locks.disable_witness()
+    best_off, best_on = max(offs), max(ons)
+    return {"qps_off": offs, "qps_on": ons,
+            "best_off": best_off, "best_on": best_on,
+            # off-cell spread = the box's noise floor for this workload
+            "off_noise_pct": round((best_off - min(offs)) / best_off * 100, 2),
+            "on_overhead_pct": round((best_off - best_on) / best_off * 100, 2)}
+
+
+def bench_r18(quick: bool = False, *, max_batch: int = 64,
+              num_nodes: int = 2) -> dict:
+    """--scenario r18: lock-witness overhead (ISSUE 17) — one serving
+    cluster, interleaved witness off/on cells over the full
+    gateway->batcher->router->node predict path."""
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.models import linear as linmod
+
+    feature_dim = 16
+    duration = 1.5 if quick else 5.0
+    results: dict = {"scenario": "r18", "max_batch": max_batch,
+                     "num_nodes": num_nodes}
+    config = {"model": "linear", "in_dim": feature_dim,
+              "out_dim": feature_dim}
+    with tempfile.TemporaryDirectory() as tmp:
+        export = os.path.join(tmp, "bundle")
+        export_bundle(export, linmod.init_params(config, scale=2.0), config)
+        cluster = tcluster.run(
+            serving.serving_loop,
+            {"export_dir": export, "max_batch": max_batch},
+            num_executors=num_nodes,
+            input_mode=tcluster.InputMode.STREAMING,
+            heartbeat_interval=0.5,
+            reservation_timeout=120.0,
+        )
+        try:
+            gateway = cluster.serve(export, max_batch=max_batch,
+                                    max_delay_ms=5.0, queue_limit=1024,
+                                    listen_host="127.0.0.1",
+                                    reload_poll_secs=0)
+            run_inprocess(gateway, request_rows=max_batch,
+                          feature_dim=feature_dim, clients=num_nodes,
+                          duration=1.0)  # warmup: compile both replicas
+            results["compare"] = run_witness_compare(
+                gateway, request_rows=1, feature_dim=feature_dim,
+                clients=4 if quick else 16, duration=duration,
+                rounds=2 if quick else 3)
+        finally:
+            cluster.shutdown(timeout=120.0)
+    return results
+
+
+def r18_table(results: dict) -> str:
+    c = results["compare"]
+    lines = ["| cell | qps (per round) | best |",
+             "|---|---|---|"]
+    lines.append("| witness off | " + ", ".join(f"{q:.0f}" for q in c["qps_off"])
+                 + f" | {c['best_off']:.0f} |")
+    lines.append("| witness on | " + ", ".join(f"{q:.0f}" for q in c["qps_on"])
+                 + f" | {c['best_on']:.0f} |")
+    lines.append(f"\nwitness-on overhead: {c['on_overhead_pct']:+.2f}% "
+                 f"(off-cell noise floor {c['off_noise_pct']:.2f}%)")
+    return "\n".join(lines)
+
+
 _STAGE_SPANS = ("serve.request", "serve.admission", "serve.batch_fill",
                 "serve.wire", "serve.node_round", "serve.node_compute",
                 "serve.reply", "feed.partition_consume")
@@ -747,8 +843,26 @@ def main(argv=None) -> int:
     ap.add_argument("--scenario", default="",
                     help="named robustness scenario: 'r17' = hot-tenant "
                          "flood + canary swap mid-burst with an injected "
-                         "regression -> auto-rollback (BENCH_r17)")
+                         "regression -> auto-rollback (BENCH_r17); "
+                         "'r18' = lock-witness off/on overhead compare "
+                         "(BENCH_r18)")
     args = ap.parse_args(argv)
+    if args.scenario == "r18":
+        results = bench_r18(quick=args.quick)
+        print(r18_table(results))
+        c = results["compare"]
+        # off-path: one attribute check over the raw primitive (witness
+        # disarmed) — structurally within noise; measured bar: the FULL
+        # witness stays under 10% on the serving hot path
+        ok = c["on_overhead_pct"] <= 10.0
+        print(f"acceptance r18 (witness-off is a single attribute check — "
+              f"within noise by construction; witness-on overhead <= 10%): "
+              f"{'PASS' if ok else 'MISS'} ({c['on_overhead_pct']:+.2f}%)")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(results, f, indent=2)
+            print(f"raw results -> {args.json}")
+        return 0
     if args.scenario:
         if args.scenario != "r17":
             ap.error(f"unknown scenario {args.scenario!r}")

@@ -7,6 +7,7 @@ never-baselined classes present), 2 = usage error.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -34,6 +35,35 @@ def _write_knob_table(readme: Path) -> int:
     return 0
 
 
+def _dump_lockgraph(package_root: Path, out_dir: Path) -> int:
+    from tensorflowonspark_tpu.analysis import lockgraph
+
+    project_root = package_root.parent
+    mods = []
+    for path in core.iter_package_files(package_root):
+        rel = path.relative_to(project_root).as_posix()
+        try:
+            mods.append(core.ModuleSource(rel, path.read_text(encoding="utf-8")))
+        except SyntaxError:
+            continue  # the lock-order gate itself reports parse errors
+    graph = lockgraph.build_lockgraph(mods)
+    dot, js = lockgraph.dump_lockgraph(graph, out_dir)
+    n_edges = sum(len(bs) for bs in graph.edges.values())
+    print(f"lockgraph: {n_edges} edge(s) -> {dot}, {js}")
+    return 0
+
+
+def _findings_json(findings, baseline: set[str]) -> str:
+    rows = [
+        {"checker": f.checker, "path": f.path, "line": f.line,
+         "message": f.message, "hint": f.hint, "id": fid,
+         "baselined": fid in baseline}
+        for f, fid in core.finding_ids(findings)
+    ]
+    return json.dumps({"schema": "toslint-findings-v1", "findings": rows},
+                      indent=2, sort_keys=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="toslint",
@@ -54,6 +84,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the generated README knob table and exit")
     parser.add_argument("--write-knob-table", action="store_true",
                         help="rewrite the README knob-table block in place")
+    parser.add_argument("--dump-lockgraph", type=Path, default=None,
+                        metavar="DIR",
+                        help="write the resolved whole-tree lock graph as "
+                             "lockgraph.dot + lockgraph.json into DIR (CI "
+                             "artifacts) and exit")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="finding output format (json = machine-readable "
+                             "rows for every finding, new and baselined)")
     args = parser.parse_args(argv)
 
     if args.list_checkers:
@@ -69,6 +107,8 @@ def main(argv: list[str] | None = None) -> int:
     package_root = (args.package_root or core.default_package_root()).resolve()
     if args.write_knob_table:
         return _write_knob_table(package_root.parent / "README.md")
+    if args.dump_lockgraph is not None:
+        return _dump_lockgraph(package_root, args.dump_lockgraph)
 
     checker_ids = (None if args.checkers is None
                    else [s.strip() for s in args.checkers.split(",") if s.strip()])
@@ -97,6 +137,9 @@ def main(argv: list[str] | None = None) -> int:
 
     baseline = core.load_baseline(baseline_path)
     new, suppressed, stale = core.partition_by_baseline(findings, baseline)
+    if args.format == "json":
+        print(_findings_json(findings, baseline))
+        return 1 if new else 0
     for f in new:
         print(core.format_finding(f))
     if stale:

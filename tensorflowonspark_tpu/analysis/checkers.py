@@ -1003,3 +1003,33 @@ class TracePurityChecker(Checker):
                     "not per step",
                     "traced functions must be pure; return the new value "
                     "instead", f"{fn_name}@{kind}:{names}")
+
+
+@register_checker
+class LockOrderChecker(Checker):
+    """tossan static half: whole-tree interprocedural lock-order analysis.
+
+    Per-file ``check`` only accumulates parsed modules; the graph build,
+    cycle detection, and callback-under-lock flags all happen in
+    ``finalize`` because an acquisition-order cycle is by definition a
+    property of the whole tree (see ``analysis/lockgraph.py``).  Findings
+    are in ``NEVER_BASELINE``: a cycle is a latent deadlock — fixed, or
+    explained inline with ``# toslint: allow-lock-order(<why>)``.
+    """
+
+    id = "lock-order"
+    hint = ("establish one global acquisition order, or annotate the edge "
+            "with `# toslint: allow-lock-order(<why>)`")
+
+    def __init__(self) -> None:
+        self._mods: list[ModuleSource] = []
+
+    def check(self, mod: ModuleSource) -> Iterator[Finding]:
+        self._mods.append(mod)
+        return iter(())
+
+    def finalize(self, project_root: Path | None) -> Iterator[Finding]:
+        from tensorflowonspark_tpu.analysis import lockgraph
+
+        graph = lockgraph.build_lockgraph(self._mods)
+        yield from lockgraph.lock_order_findings(graph)

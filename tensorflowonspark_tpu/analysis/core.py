@@ -34,11 +34,15 @@ from typing import Iterable, Iterator, Sequence
 PRAGMA_RE = re.compile(
     r"#\s*toslint:\s*"
     r"(?:(?P<silent>allow-silent)\((?P<reason>[^)]*)\)"
+    r"|(?P<lockorder>allow-lock-order)\((?P<lockreason>[^)]*)\)"
     r"|disable=(?P<ids>[\w,-]+))")
 
 # Checker classes whose findings must be FIXED, never grandfathered: a raw
-# env read or raw dial is always a mechanical one-line migration.
-NEVER_BASELINE = frozenset({"knob-discipline", "dial-discipline"})
+# env read or raw dial is always a mechanical one-line migration, and a
+# lock-order cycle is a latent deadlock — fixed or explained inline with
+# `# toslint: allow-lock-order(<why>)`, never waved through.
+NEVER_BASELINE = frozenset({"knob-discipline", "dial-discipline",
+                            "lock-order"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,12 +68,15 @@ class Pragmas:
     def __init__(self, lines: Sequence[str]):
         self._silent: dict[int, str] = {}  # line -> reason
         self._disabled: dict[int, set[str]] = {}  # line -> checker ids
+        self.lock_order: dict[int, str] = {}  # line -> reason
         for i, text in enumerate(lines, start=1):
             m = PRAGMA_RE.search(text)
             if not m:
                 continue
             if m.group("silent"):
                 self._silent[i] = (m.group("reason") or "").strip()
+            elif m.group("lockorder"):
+                self.lock_order[i] = (m.group("lockreason") or "").strip()
             else:
                 self._disabled[i] = {s.strip() for s in m.group("ids").split(",") if s.strip()}
 
@@ -77,6 +84,11 @@ class Pragmas:
         """True when any of the lines carries allow-silent WITH a reason
         (a reason-less pragma documents nothing and suppresses nothing)."""
         return any(self._silent.get(i) for i in lines)
+
+    def allow_lock_order(self, *lines: int) -> bool:
+        """True when any of the lines carries allow-lock-order WITH a
+        reason (same rule as allow-silent: no reason, no suppression)."""
+        return any(self.lock_order.get(i) for i in lines)
 
     def disabled(self, line: int, checker_id: str) -> bool:
         ids = self._disabled.get(line)

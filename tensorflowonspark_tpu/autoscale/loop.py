@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 
 from tensorflowonspark_tpu import telemetry
@@ -106,7 +107,7 @@ class Autoscaler:
         self._drain_timeout = drain_timeout
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("autoscale._lock")
         self._decisions: list[dict] = []
         self._counts = {"scale_out": 0, "scale_in": 0, "cooldown_hold": 0,
                         "resize_failures": 0}

@@ -19,6 +19,7 @@ import json
 import logging
 import os
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 from typing import Any, Callable
 
 from tensorflowonspark_tpu.utils.paths import resolve_uri
@@ -433,7 +434,7 @@ def export_stablehlo(export_dir: str, params: Any, model_config: dict,
 
 
 _BUNDLE_CACHE: dict[str, tuple[Any, dict, Callable]] = {}
-_BUNDLE_LOCK = threading.Lock()
+_BUNDLE_LOCK = tos_named_lock("checkpoint._bundle_lock")
 # single-flight per export_dir: the loader-elect's event, waited on by every
 # concurrent caller of the same key so N serving threads cost ONE load
 _BUNDLE_LOADING: dict[str, threading.Event] = {}

@@ -30,6 +30,7 @@ import logging
 import os
 import secrets
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_condition, tos_named_lock
 import time
 from typing import Any, Callable, Sequence
 
@@ -141,7 +142,7 @@ class _PartitionLedger:
         # instance is replaced by every recovery, so it is never cached.
         self._journal_fn = journal_fn
         self._train_gen = train_gen
-        self._cond = threading.Condition()
+        self._cond = tos_named_condition("cluster.ledger._cond")
         self._own = [
             collections.deque((e, p)
                               for e in range(num_epochs)
@@ -494,8 +495,8 @@ class TPUCluster:
         #   drain) the moment shutdown begins, so teardown never races a
         #   resize mutating _feed_ids.
         self._closing = threading.Event()
-        self._resize_lock = threading.Lock()
-        self._train_lock = threading.Lock()
+        self._resize_lock = tos_named_lock("cluster._resize_lock")
+        self._train_lock = tos_named_lock("cluster._train_lock")
         self._train_session: dict | None = None
         # live inference() calls (guarded by _train_lock): scale-in refuses
         # while one is in flight — its partitions are statically assigned
@@ -1321,7 +1322,7 @@ class TPUCluster:
             window = dataset.num_partitions + 1
         window = window if window is not None else max(2 * num_workers, 4)
         buf: dict[int, list] = {}
-        cond = threading.Condition()
+        cond = tos_named_condition("cluster.drain._cond")
         state = {"next": 0, "stopped": False, "done": 0}
         errors: list[Exception] = []
 

@@ -40,6 +40,7 @@ import contextlib
 import logging
 import socket
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_condition, tos_named_lock
 import time
 
 from tensorflowonspark_tpu import telemetry
@@ -62,7 +63,7 @@ class CollectiveTimeout(CollectiveAborted):
 
 # -- inbox registry (the dataserver's attach handler looks groups up here) ----
 
-_registry_lock = threading.Lock()
+_registry_lock = tos_named_lock("transport._registry_lock")
 _inboxes: dict[str, "CollectiveInbox"] = {}
 
 
@@ -98,7 +99,7 @@ class CollectiveInbox:
 
     def __init__(self, name: str):
         self.name = name
-        self._cond = threading.Condition()
+        self._cond = tos_named_condition("transport.inbox._cond")
         self._frames: dict[tuple, collections.deque] = {}
         # src rank -> highest generation a broken connection was serving:
         # receives at or below it abort fast, above it are a NEW connection
@@ -306,7 +307,7 @@ class PeerTransport:
         self.name = name
         self.authkey = authkey
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("transport.peer._lock")
         self._conns: dict[int, socket.socket] = {}
         self._members: list[dict] = []
         self._generation = 0

@@ -31,6 +31,7 @@ import socket
 import socketserver
 import struct
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_condition, tos_named_lock
 import time
 from typing import Any
 
@@ -122,7 +123,7 @@ class _Rendezvous:
 
     def __init__(self, count: int):
         self.count = count
-        self.cond = threading.Condition()
+        self.cond = tos_named_condition("coordinator.rendezvous._cond")
         self.values: list[Any] = []
         self.result: Any = None
         self.done = False
@@ -234,7 +235,7 @@ class CoordinatorServer:
         self.authkey = authkey
         # role for executor i; default: executor 0 is chief, rest workers.
         self.roles = roles or [("chief", 0)] + [("worker", i) for i in range(1, expected)]
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("coordinator._lock")
         self._nodes: list[dict] = []
         self._complete = threading.Event()
         self._stop_flag = threading.Event()
@@ -2022,7 +2023,7 @@ class CoordinatorClient:
         from tensorflowonspark_tpu.utils.envtune import env_int
 
         self.address = (address[0], int(address[1]))
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("coordinator.client._lock")
         self._authkey = authkey
         self._connect_timeout = connect_timeout
         # Backoff on the dial (TOS_CONNECT_ATTEMPTS): a single-shot connect

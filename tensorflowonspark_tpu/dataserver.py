@@ -45,6 +45,7 @@ import queue
 import socket
 import struct
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 from time import monotonic as _monotonic
 from typing import Any, Iterable
 
@@ -718,7 +719,7 @@ class DataClient:
             attempts=(connect_attempts if connect_attempts is not None
                       else env_int("TOS_CONNECT_ATTEMPTS", 3)))
         self._sock.settimeout(None)
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("dataserver.client._lock")
         self._consumed_reported: dict[str, int] = {}
         if not _hmac_handshake_client(self._sock, authkey):
             self._sock.close()
@@ -1124,6 +1125,13 @@ class DataClient:
             self._c2s = self._s2c = None
         try:
             with self._lock:
+                # Bounded, unlike the old bare blocking recv: the lockgraph
+                # shows cluster.resize and gateway.reload reach this lock
+                # while holding their own (cluster._resize_lock /
+                # gateway._reload_lock -> dataserver.client._lock), so a
+                # wedged-but-alive node must not pin close() — and those
+                # callers — forever.
+                self._sock.settimeout(min(10.0, self.call_timeout))
                 _send(self._sock, ("close",))
                 try:
                     _recv(self._sock)

@@ -91,6 +91,7 @@ import logging
 import os
 import signal
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 
 logger = logging.getLogger(__name__)
@@ -173,7 +174,7 @@ class FaultPlan:
                              "hot_tenant"})
 
     def __init__(self, actions: list[_Action]):
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("faultinject._lock")
         self._actions = actions
         self._executor_id: int | None = None
         self._incarnation = 0

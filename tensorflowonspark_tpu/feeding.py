@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 from typing import Any, Iterable, Sequence
 
 from tensorflowonspark_tpu import faultinject, telemetry
@@ -59,7 +60,7 @@ class FeedQueues:
         # would over-advance the driver's watermark past still-buffered work.
         self._consumed: dict[str, int] = {name: 0 for name in qnames}
         self._consumed_keys: dict[str, set] = {name: set() for name in qnames}
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("feeding._lock")
 
     def get_queue(self, qname: str) -> queue.Queue:
         try:

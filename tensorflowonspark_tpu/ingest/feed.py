@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 from typing import Any, Iterable
 
 from time import monotonic as _monotonic
@@ -154,7 +155,7 @@ class IngestFeed:
         self._occupancy = telemetry.gauge("feed.queue_depth")
         # partitions fully read AND fully handed to the map_fun, awaiting
         # the safe moment to report (see _report_ready_keys)
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = tos_named_lock("feed._jobs_lock")
         self._ready_keys: list = []
         self._claimer = threading.Thread(target=self._claim_loop, daemon=True,
                                          name="ingest-claimer")

@@ -39,6 +39,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 
 from tensorflowonspark_tpu import telemetry
@@ -196,7 +197,7 @@ class ReaderPipeline:
         self._out: queue.Queue = queue.Queue(maxsize=0 if self._sync else depth)
         self._work: queue.Queue = queue.Queue()  # paths: tiny, unbounded
         self._stop = stop_event if stop_event is not None else threading.Event()
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("readers._lock")
         self._active = 0
         self._target = 1 if self._autotune else self._max_readers
         self._closed = False

@@ -50,6 +50,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 
 from tensorflowonspark_tpu import telemetry
@@ -101,7 +102,7 @@ class ChunkCache:
     def __init__(self, max_bytes: int | None = None):
         self.max_bytes = max(0, int(max_bytes if max_bytes is not None
                                     else cache_bytes_default()))
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("service.cache._lock")
         self._entries: collections.OrderedDict = collections.OrderedDict()
         self._bytes = 0
 

@@ -37,6 +37,7 @@ import json
 import logging
 import os
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +56,7 @@ class Journal:
 
     def __init__(self, path: str, truncate: bool = False):
         self.path = str(path)
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("journal._lock")
         self._closed = False
         self._seq = 0
         self._since_snapshot = 0

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_condition
 from time import monotonic as _monotonic
 from typing import Any, Callable, Sequence
 
@@ -165,7 +166,7 @@ class MicroBatcher:
         self._dispatch = dispatch
         self._pause_fn = pause_fn or (lambda: False)
         self._capacity_fn = capacity_fn or (lambda: True)
-        self._cond = threading.Condition()
+        self._cond = tos_named_condition("batcher._cond")
         # tenant-aware admission queue (per-tenant FIFOs, DRR drain, token
         # buckets, brownout ladder) — owned here, every access under _cond
         self._queue = TenantQueues(queue_limit=self.queue_limit,

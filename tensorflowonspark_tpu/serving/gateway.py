@@ -55,6 +55,7 @@ import contextlib
 import logging
 import math
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 from time import monotonic as _monotonic
 from typing import Any, Sequence
 
@@ -126,7 +127,7 @@ class ServingGateway:
         self._authkey = cluster.authkey
         self._closed = False
         self._reloading = False
-        self._reload_lock = threading.Lock()
+        self._reload_lock = tos_named_lock("gateway._reload_lock")
         self._rollout: RolloutGovernor | None = None
         self._router = ReplicaRouter(cluster, None,  # batcher set just below
                                      qname_in=qname_in, qname_out=qname_out,
@@ -580,8 +581,8 @@ class GatewayClient:
         # frame-write serializer: interleaved sendmsg from two threads would
         # interleave frame bytes (same deliberate hold-lock-across-I/O
         # pattern as DataClient._call; baselined in analysis/baseline.json)
-        self._send_lock = threading.Lock()
-        self._lock = threading.Lock()  # id counter + pending map + closed
+        self._send_lock = tos_named_lock("gateway.client._send_lock")
+        self._lock = tos_named_lock("gateway.client._lock")  # id counter + pending map + closed
         self._pending: dict[int, _GatewayFuture] = {}
         self._next_id = 1
         self._closed = False
@@ -792,7 +793,7 @@ class LegacyGatewayClient:
             raise RuntimeError("gateway auth handshake failed")
         # request/reply serializer (same deliberate hold-lock-across-I/O
         # pattern as DataClient._call; baselined in analysis/baseline.json)
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("gateway.legacy._lock")
 
     def _call(self, msg: tuple):
         with self._lock:

@@ -39,6 +39,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 from time import monotonic as _monotonic
 
@@ -198,7 +199,7 @@ class RolloutGovernor:
         self.latency_factor = float(latency_factor)
         self.latency_floor = float(latency_floor_secs)
         self.poll = max(0.05, float(poll_secs))
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("rollout._lock")
         self._stop_evt = threading.Event()
         self._done = threading.Event()
         self._thread: threading.Thread | None = None

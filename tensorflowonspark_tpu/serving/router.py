@@ -52,6 +52,7 @@ import contextlib
 import itertools
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_condition
 from time import monotonic as _monotonic
 from typing import Any
 
@@ -124,7 +125,7 @@ class ReplicaRouter:
         # worker for the feed-path's ~10-minute budget.
         self._stall_timeout = max(10.0, 2.0 * request_timeout)
         self._call_timeout = self._stall_timeout + 30.0
-        self._cond = threading.Condition()
+        self._cond = tos_named_condition("router._cond")
         self._stop = False
         self._pick_seq = 0
         self._resync_seq = 0  # recovery-thread only; nonces for _resync

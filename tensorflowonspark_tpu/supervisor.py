@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+from tensorflowonspark_tpu.utils.locks import tos_named_lock
 import time
 
 from tensorflowonspark_tpu import telemetry
@@ -88,7 +89,7 @@ class CoordinatorSupervisor:
     def __init__(self, server, policy: RestartPolicy | None = None):
         self.server = server
         self.policy = policy or RestartPolicy.from_env()
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("supervisor.coord._lock")
         self._stopped = threading.Event()
         self._restarts = 0
         self._permanent: str | None = None
@@ -179,7 +180,7 @@ class Supervisor:
         # supervisor treats its boot as another death (the monitor can only
         # re-detect nodes that made it into liveness tracking).
         self._reregister_timeout = env_float("TOS_REREGISTER_TIMEOUT", 60.0)
-        self._lock = threading.Lock()
+        self._lock = tos_named_lock("supervisor._lock")
         self._stopped = threading.Event()
         self._restarts: dict[int, int] = {}
         self._permanent: dict[int, str] = {}

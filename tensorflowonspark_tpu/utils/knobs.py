@@ -126,6 +126,16 @@ _ALL = (
          "standalone ingest-worker nodes (role='ingest') that claim the "
          "DIRECT-mode ledger's shard items, decode on their own cores, "
          "and stream chunks to trainers; 0 keeps decode node-local."),
+    Knob("TOS_LOCK_WITNESS", "str", "0 (off)",
+         "Runtime lock witness (tossan): 1/raise records per-thread "
+         "held-sets + the global acquisition-order graph over every "
+         "tos_named_lock and raises LockOrderError at acquire time on an "
+         "order inversion; 'warn' records inversions without raising; 0 "
+         "reduces the witness to a single attribute check per acquire."),
+    Knob("TOS_LOCK_STALL_SECS", "float", "5",
+         "Lock witness stall budget: a witnessed acquire that has waited "
+         "this long dumps all-thread stacks to the flight recorder "
+         "(lock_stall event) once per wait episode."),
     Knob("TOS_INGEST_AUTOTUNE", "bool", "1",
          "DIRECT-mode ingest: autotune reader parallelism from decode-queue "
          "occupancy (start at 1, grow while the consumer starves, shrink "

@@ -79,6 +79,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("TPU_FRAMEWORK_TEST", "1")
+# tossan runtime half: the whole tier-1 suite runs under the lock witness
+# (TOS_LOCK_WITNESS=1 -> raise on acquisition-order inversion), so every
+# chaos test doubles as a deadlock-sanitized run.  Set via os.environ — not
+# a fixture — so spawned node processes inherit it; the witness itself
+# initializes lazily at the first tos_named_lock() call in each process.
+os.environ.setdefault("TOS_LOCK_WITNESS", "1")
 
 import jax  # noqa: E402
 
@@ -106,3 +112,23 @@ _SESSION_T0 = _time.monotonic()
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     terminalreporter.write_line(
         f"tier-1 total wall time: {_time.monotonic() - _SESSION_T0:.1f}s")
+
+
+# -- tossan lock witness (ISSUE 17) -------------------------------------------
+#
+# In raise mode an inversion fails the offending test at the acquire site;
+# this autouse backstop additionally fails the SESSION if a warn-mode run
+# (TOS_LOCK_WITNESS=warn) recorded inversions nothing raised for.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_gate():
+    yield
+    from tensorflowonspark_tpu.utils import locks
+
+    witness = locks.get_witness()
+    if witness is not None and witness.inversions:
+        pytest.fail("lock witness recorded order inversions:\n"
+                    + "\n".join(witness.inversions))

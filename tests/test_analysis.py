@@ -1072,3 +1072,241 @@ def test_every_registered_knob_has_doc_and_default():
     for k in knobs.KNOBS.values():
         assert k.doc and k.default and k.kind in {"float", "int", "str", "bool"}
     assert knobs.knob_table_markdown().splitlines()[0].startswith("| Knob ")
+
+
+# -- lock-order (tossan static half, ISSUE 17) --------------------------------
+
+
+def lock_findings(files: dict[str, str]) -> list[core.Finding]:
+    """Build the whole-tree lock graph over in-memory modules and return
+    the lock-order findings (the checker's finalize path, unit-sized)."""
+    from tensorflowonspark_tpu.analysis import lockgraph
+
+    mods = [core.ModuleSource(p, textwrap.dedent(s))
+            for p, s in files.items()]
+    return list(lockgraph.lock_order_findings(lockgraph.build_lockgraph(mods)))
+
+
+_CYCLE_A = f"""
+    from {PKG}.utils.locks import tos_named_lock
+    from {PKG}.bmod import B
+
+    class A:
+        def __init__(self):
+            self._lock = tos_named_lock("a._lock")
+            self._b = B()
+
+        def m(self):
+            with self._lock:
+                self._b.n()
+    """
+
+_CYCLE_B = f"""
+    from {PKG}.utils.locks import tos_named_lock
+    from {PKG}.amod import A
+
+    class B:
+        def __init__(self):
+            self._lock = tos_named_lock("b._lock")
+            self._a = A()
+
+        def n(self):
+            with self._lock:
+                pass
+
+        def r(self):
+            with self._lock:
+                self._a.m()
+    """
+
+
+def test_lock_order_fires_on_two_module_cycle():
+    found = lock_findings({f"{PKG}/amod.py": _CYCLE_A,
+                           f"{PKG}/bmod.py": _CYCLE_B})
+    assert len(found) == 1
+    f = found[0]
+    assert f.checker == "lock-order"
+    assert "potential deadlock" in f.message
+    # the full witness chain names both locks and both call sites
+    assert "a._lock -> b._lock" in f.message
+    assert "b._lock -> a._lock" in f.message
+    assert "amod.py" in f.message and "bmod.py" in f.message
+    assert f.anchor == "cycle:a._lock->b._lock"
+
+
+def test_lock_order_quiet_on_diamond_without_cycle():
+    found = lock_findings({f"{PKG}/dmod.py": f"""
+        from {PKG}.utils.locks import tos_named_lock
+
+        class D:
+            def __init__(self):
+                self._a = tos_named_lock("d.a")
+                self._b = tos_named_lock("d.b")
+                self._c = tos_named_lock("d.c")
+                self._d = tos_named_lock("d.d")
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._a:
+                    with self._c:
+                        pass
+
+            def m3(self):
+                with self._b:
+                    with self._d:
+                        pass
+
+            def m4(self):
+                with self._c:
+                    with self._d:
+                        pass
+        """})
+    assert found == []
+
+
+def test_lock_order_pragma_with_reason_suppresses_cycle():
+    b_blessed = _CYCLE_B.replace(
+        "self._a.m()",
+        "self._a.m()  # toslint: allow-lock-order(startup-only path, "
+        "externally serialized)")
+    found = lock_findings({f"{PKG}/amod.py": _CYCLE_A,
+                           f"{PKG}/bmod.py": b_blessed})
+    assert found == []
+    # a reason-less pragma documents nothing and suppresses nothing
+    b_bare = _CYCLE_B.replace("self._a.m()",
+                              "self._a.m()  # toslint: allow-lock-order()")
+    found = lock_findings({f"{PKG}/amod.py": _CYCLE_A,
+                           f"{PKG}/bmod.py": b_bare})
+    assert len(found) == 1
+
+
+def test_lock_order_flags_callback_fired_under_lock():
+    found = lock_findings({f"{PKG}/cbmod.py": f"""
+        from {PKG}.utils.locks import tos_named_lock
+
+        class Batcher:
+            def __init__(self, on_done):
+                self._lock = tos_named_lock("batcher._lock")
+                self._cb = on_done
+
+            def fire(self):
+                with self._lock:
+                    self._cb(1)
+
+        class User:
+            def __init__(self):
+                self._lock = tos_named_lock("user._lock")
+                self._batcher = Batcher(on_done=self._handle)
+
+            def _handle(self, x):
+                with self._lock:
+                    pass
+        """})
+    assert any(f.anchor == "callback:_cb@user._lock" for f in found)
+    f = next(f for f in found if f.anchor.startswith("callback:"))
+    assert "batcher._lock" in f.message and "_handle" in f.message
+
+
+def test_lock_order_quiet_on_callback_fired_outside_lock():
+    # the batcher's _fire_done pattern: collect under the lock, invoke after
+    found = lock_findings({f"{PKG}/cbmod.py": f"""
+        from {PKG}.utils.locks import tos_named_lock
+
+        class Batcher:
+            def __init__(self, on_done):
+                self._lock = tos_named_lock("batcher._lock")
+                self._cb = on_done
+
+            def fire(self):
+                with self._lock:
+                    batch = [1]
+                self._cb(batch)
+
+        class User:
+            def __init__(self):
+                self._lock = tos_named_lock("user._lock")
+                self._batcher = Batcher(on_done=self._handle)
+
+            def _handle(self, x):
+                with self._lock:
+                    pass
+        """})
+    assert found == []
+
+
+def test_lock_order_sees_cycle_through_module_function_and_local_var():
+    # interprocedural depth: a module function constructs a tree class into
+    # a LOCAL and calls through it; unnamed threading.Lock attrs get
+    # synthesized <module>.<Class>.<attr> node ids
+    found = lock_findings({f"{PKG}/x.py": f"""
+        import threading
+        from {PKG}.y import helper
+
+        class X:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def m(self):
+                with self._lock:
+                    helper()
+        """, f"{PKG}/y.py": f"""
+        import threading
+        from {PKG}.x import X
+
+        class Y:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def n(self):
+                with self._lock:
+                    x = X()
+                    x.m()
+
+        def helper():
+            y = Y()
+            y.n()
+        """})
+    assert len(found) == 1
+    assert "x.X._lock" in found[0].message
+    assert "y.Y._lock" in found[0].message
+
+
+def test_lock_order_refuses_baseline(tmp_path):
+    # like knob/dial classes: --baseline-update refuses lock-order findings
+    assert "lock-order" in core.NEVER_BASELINE
+    f = core.Finding("lock-order", f"{PKG}/amod.py", 3, "cycle", "fix",
+                     "cycle:a._lock->b._lock")
+    refused = core.write_baseline(tmp_path / "b.json", [f])
+    assert refused == [f]
+    assert core.load_baseline(tmp_path / "b.json") == set()
+
+
+def test_dump_lockgraph_cli_writes_dot_and_json(tmp_path, capsys):
+    from tensorflowonspark_tpu.analysis.__main__ import main
+
+    assert main(["--dump-lockgraph", str(tmp_path / "lg")]) == 0
+    dot = (tmp_path / "lg" / "lockgraph.dot").read_text()
+    data = json.loads((tmp_path / "lg" / "lockgraph.json").read_text())
+    assert dot.startswith("digraph lockgraph")
+    assert data["schema"] == "tos-lockgraph-v1"
+    # the real tree's cross-module spine is in the resolved graph
+    edges = {(e["from"], e["to"]) for e in data["edges"]}
+    assert ("coordinator._lock", "journal._lock") in edges
+    for e in data["edges"]:
+        assert e["witness"], e  # every edge carries its witness chain
+
+
+def test_cli_format_json_emits_machine_rows(capsys):
+    from tensorflowonspark_tpu.analysis.__main__ import main
+
+    assert main(["--format=json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["schema"] == "toslint-findings-v1"
+    assert all(set(r) == {"checker", "path", "line", "message", "hint",
+                          "id", "baselined"} for r in data["findings"])
+    # a clean tree still reports its baselined findings, marked as such
+    assert all(r["baselined"] for r in data["findings"])

@@ -45,3 +45,15 @@ def test_cli_module_exits_zero_on_clean_tree():
     from tensorflowonspark_tpu.analysis.__main__ import main
 
     assert main([]) == 0
+
+
+def test_lock_order_gate_zero_unexplained_cycles():
+    # tossan static half (ISSUE 17): the whole-tree acquired-while-held
+    # graph has no cycle that isn't explained by a reasoned
+    # `# toslint: allow-lock-order(...)` pragma.  lock-order is a
+    # NEVER_BASELINE class, so run_analysis returning nothing IS the gate —
+    # there is no baseline that could be hiding one.
+    findings = core.run_analysis(checker_ids=["lock-order"])
+    assert not findings, (
+        "lock-order cycles (fix the acquisition order or annotate the "
+        "edge):\n" + "\n".join(core.format_finding(f) for f in findings))

@@ -1,0 +1,362 @@
+"""tossan runtime half (ISSUE 17): the lock witness.
+
+Unit coverage for the order witness (AB/BA inversion raises at acquire
+time with both stacks named; warn mode records instead), the stall dump
+(all-thread stacks land in the flight ring), the ``threading.Condition``
+integration (``wait()`` keeps the held-set exact), hold-time telemetry,
+and the witness-off fast path — plus the chaos regression: a
+``stall_collective`` soak under the witness reports zero inversions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import trace as ttrace
+from tensorflowonspark_tpu.utils import locks
+from tensorflowonspark_tpu.utils.locks import (
+    LockOrderError,
+    tos_named_condition,
+    tos_named_lock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _witness_sandbox():
+    """Each test gets a private witness; afterwards the suite-wide armed
+    state (conftest sets TOS_LOCK_WITNESS=1) is restored with a fresh
+    graph so no test-local edges leak into later tests."""
+    prev = locks.get_witness()
+    yield
+    if prev is not None:
+        locks.enable_witness(mode=prev.mode)
+    else:
+        locks.disable_witness()
+
+
+# -- order witness -------------------------------------------------------------
+
+
+def test_ab_ba_inversion_raises_with_both_stacks_named():
+    locks.enable_witness(mode="raise")
+    a = tos_named_lock("t17.a")
+    b = tos_named_lock("t17.b")
+    with a:
+        with b:  # establishes t17.a -> t17.b
+            pass
+    with b:
+        with pytest.raises(LockOrderError) as exc:
+            a.acquire()  # closes the cycle
+    msg = str(exc.value)
+    assert "t17.a" in msg and "t17.b" in msg
+    assert "closes the cycle" in msg
+    # both witnesses present: the offending acquisition AND the
+    # first-observed reverse edge, each with a stack naming this file
+    assert "this acquisition" in msg
+    assert "first-observed reverse edge" in msg
+    assert msg.count("test_locks.py") >= 2
+
+
+def test_inversion_caught_without_deadly_interleaving():
+    # the whole point of the witness: thread 1 ran a->b, thread 2 runs
+    # b->a LATER (no concurrent embrace), and it still raises
+    locks.enable_witness(mode="raise")
+    a = tos_named_lock("t17.seq_a")
+    b = tos_named_lock("t17.seq_b")
+
+    def order_one():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=order_one)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+
+
+def test_transitive_cycle_through_three_locks_raises():
+    locks.enable_witness(mode="raise")
+    a = tos_named_lock("t17.tri_a")
+    b = tos_named_lock("t17.tri_b")
+    c = tos_named_lock("t17.tri_c")
+    with a, b:
+        pass
+    with b, c:
+        pass
+    with c:
+        with pytest.raises(LockOrderError, match="tri_a.*tri_b.*tri_c"):
+            a.acquire()
+
+
+def test_warn_mode_records_instead_of_raising():
+    w = locks.enable_witness(mode="warn")
+    a = tos_named_lock("t17.warn_a")
+    b = tos_named_lock("t17.warn_b")
+    with a, b:
+        pass
+    with b:
+        with a:  # inversion: recorded, not raised
+            pass
+    assert len(w.inversions) == 1
+    assert "warn_a" in w.inversions[0]
+
+
+def test_same_named_instances_share_one_graph_node():
+    # two Journal instances both name their lock journal._lock: ordered
+    # acquisition of DIFFERENT instances must not self-edge or raise
+    locks.enable_witness(mode="raise")
+    j1 = tos_named_lock("t17.journal._lock")
+    j2 = tos_named_lock("t17.journal._lock")
+    with j1:
+        with j2:  # same node name: no a->a edge, no cycle
+            pass
+    assert "t17.journal._lock" not in locks.order_graph().get(
+        "t17.journal._lock", [])
+
+
+def test_self_deadlock_on_nonreentrant_reacquire():
+    locks.enable_witness(mode="raise")
+    a = tos_named_lock("t17.self")
+    with a:
+        with pytest.raises(LockOrderError, match="self-deadlock"):
+            a.acquire()
+
+
+def test_reentrant_lock_reacquires_cleanly():
+    locks.enable_witness(mode="raise")
+    r = tos_named_lock("t17.re", reentrant=True)
+    with r:
+        with r:
+            assert r.locked()
+    assert not r.locked()
+
+
+def test_order_graph_snapshot():
+    locks.enable_witness(mode="raise")
+    a = tos_named_lock("t17.g_a")
+    b = tos_named_lock("t17.g_b")
+    with a, b:
+        pass
+    assert locks.order_graph()["t17.g_a"] == ["t17.g_b"]
+
+
+# -- stall dump ----------------------------------------------------------------
+
+
+def test_stall_dump_lands_in_flight_ring():
+    locks.enable_witness(mode="raise", stall_secs=0.15)
+    ttrace.reset(enabled=False, flight_events=32)
+    try:
+        lock = tos_named_lock("t17.stall")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                held.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, name="t17-holder")
+        t.start()
+        held.wait(5.0)
+        # this wait exceeds the stall budget -> the WAITER dumps stacks
+        assert not lock.acquire(timeout=0.5)
+        release.set()
+        t.join()
+        events = [e for e in ttrace.flight_snapshot()["events"]
+                  if e.get("kind") == "lock_stall"]
+        assert len(events) == 1  # once per episode, not once per slice
+        ev = events[0]
+        assert ev["lock"] == "t17.stall"
+        assert ev["holder"] == "t17-holder"
+        # every thread's stack is in the dump; the holder's names its wait
+        assert "t17-holder" in ev["stacks"]
+        assert "release.wait" in ev["stacks"]["t17-holder"]
+    finally:
+        ttrace.reset()
+
+
+def test_short_caller_timeout_is_not_a_stall():
+    locks.enable_witness(mode="raise", stall_secs=5.0)
+    ttrace.reset(enabled=False, flight_events=32)
+    try:
+        lock = tos_named_lock("t17.brief")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                held.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        held.wait(5.0)
+        assert not lock.acquire(timeout=0.05)  # expires well under budget
+        release.set()
+        t.join()
+        assert [e for e in ttrace.flight_snapshot()["events"]
+                if e.get("kind") == "lock_stall"] == []
+    finally:
+        ttrace.reset()
+
+
+# -- Condition integration -----------------------------------------------------
+
+
+def test_condition_wait_keeps_held_set_exact():
+    w = locks.enable_witness(mode="raise")
+    cond = tos_named_condition("t17.cond")
+    other = tos_named_lock("t17.other")
+    seen_during_wait = []
+
+    def poker():
+        # while the waiter sleeps inside cond.wait() it must NOT hold the
+        # lock in the witness's eyes: acquiring other -> cond here would
+        # otherwise record edges against a phantom holder
+        with cond:
+            seen_during_wait.append(w.held_names())
+            cond.notify()
+
+    with cond:
+        assert w.held_names() == ["t17.cond"]
+        t = threading.Thread(target=poker)
+        t.start()
+        cond.wait(timeout=5.0)
+        # re-acquired after wait: held again, exactly once
+        assert w.held_names() == ["t17.cond"]
+        with other:
+            assert w.held_names() == ["t17.cond", "t17.other"]
+    t.join()
+    assert w.held_names() == []
+    assert seen_during_wait == [["t17.cond"]]
+
+
+def test_condition_inversion_detected_through_wait():
+    locks.enable_witness(mode="raise")
+    cond = tos_named_condition("t17.cwait")
+    other = tos_named_lock("t17.cother")
+    with cond:
+        with other:  # t17.cwait -> t17.cother
+            pass
+    with other:
+        with pytest.raises(LockOrderError):
+            with cond:
+                pass
+
+
+# -- telemetry + fast path -----------------------------------------------------
+
+
+def test_hold_time_histogram_emitted_on_release():
+    locks.enable_witness(mode="raise")
+    telemetry.reset(enabled=True)
+    lock = tos_named_lock("t17.held_ms")
+    with lock:
+        time.sleep(0.01)
+    digest = telemetry.snapshot()["histograms"]["lock.hold_ms.t17.held_ms"]
+    assert digest["count"] == 1
+    assert digest["max"] >= 5.0  # milliseconds
+
+
+def test_witness_off_is_a_plain_lock():
+    locks.disable_witness()
+    lock = tos_named_lock("t17.off")
+    cond = tos_named_condition("t17.off_cond")
+    a = tos_named_lock("t17.off_a")
+    with a, lock:  # no witness: no graph, no ordering, no telemetry
+        pass
+    with lock, a:  # the inversion passes silently
+        pass
+    with cond:
+        cond.notify_all()
+    assert locks.order_graph() == {}
+    assert lock.acquire(timeout=0.1)
+    lock.release()
+
+
+def test_nonblocking_acquire_contended_returns_false():
+    locks.enable_witness(mode="raise")
+    lock = tos_named_lock("t17.nb")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    held.wait(5.0)
+    assert lock.acquire(blocking=False) is False
+    release.set()
+    t.join()
+
+
+# -- chaos regression: stall_collective soak under the witness ----------------
+
+
+@pytest.mark.chaos
+def test_chaos_stall_soak_reports_zero_inversions(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 17): a gray-stall soak — the nastiest lock
+    traffic the tree has (collective inbox conditions, coordinator
+    eviction votes, journal appends, supervisor park/unpark) — completes
+    under the witness with ZERO order-inversion reports.
+
+    Node processes inherit TOS_LOCK_WITNESS=1 (raise mode) from the
+    conftest env: an inversion in any node crashes that node and fails
+    the run.  The driver re-arms in warn mode so this test can ALSO
+    assert the recorded list is empty rather than relying on no-crash."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu.launcher import SubprocessLauncher
+
+    import mapfuns
+
+    w = locks.enable_witness(mode="warn")
+    monkeypatch.setenv("TOS_COLLECTIVE_PROBATION_SECS", "600")
+    total_steps = 4
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    cluster = tcluster.run(
+        mapfuns.sync_gray_chaos,
+        {"steps": total_steps, "out_dir": out_dir, "timeout": 30.0,
+         "reform_budget": 4.0, "run_budget": 90.0},
+        num_executors=3, input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(), log_dir=str(tmp_path),
+        heartbeat_interval=0.5, elastic=True,
+        env={"TOS_FAULTINJECT":
+             "stall_collective:after_rounds=3,secs=8,executor=1,"
+             "incarnation=0"},
+        reservation_timeout=120.0)
+    deadline = time.monotonic() + 150.0
+    recs = {}
+    while time.monotonic() < deadline and len(recs) < 3:
+        for eid in (0, 1, 2):
+            path = os.path.join(out_dir, f"gray_{eid}.txt")
+            if eid not in recs and os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        recs[eid] = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    pass  # mid-write; retry next poll
+        time.sleep(0.25)
+    cluster.shutdown(timeout=300.0)
+    # the soak ran to completion: survivors did the full step count
+    assert sorted(recs) == [0, 1, 2]
+    for eid in (0, 2):
+        assert recs[eid]["steps"] == total_steps
+    # and the whole stall -> suspect -> evict -> reform dance, driver side
+    # included, produced not one order inversion
+    assert w.inversions == []
