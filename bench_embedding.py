@@ -1,0 +1,287 @@
+"""Sharded-embedding bench: synthetic-Criteo train rows/s + gateway qps.
+
+The number this bench exists to produce (ISSUE 19 / BENCH_r19): the
+**sparse-vs-dense exchanged-bytes ratio** of the embedding tier.  A
+wide-and-deep table at paper scale (26 slots x ~100k hashed vocab x
+(16+1) fused float32 columns) is ~177 MB; replicating it and averaging
+its dense gradient every step costs each node a ``2(W-1)/W x table``
+all-reduce — ~177 MB/step/node at W=2 — while the sharded tier exchanges
+only the rows a step actually touches (unique-id CSR frames: requests,
+gathered rows, scattered gradient rows), metered on the wire by
+``collective.tx_bytes``.  Same model, same data, three-orders-of-magnitude
+fewer bytes: that ratio is the algorithmic headline; rows/s (train) and
+qps (gateway serve over resident shards) are the throughput context on a
+single box.
+
+Phases, one run:
+
+- **train** — a real W=2 cluster (``SubprocessLauncher`` node processes,
+  collective wire on each node's data port) runs the sharded
+  wide-and-deep loop: fused-table lookup (two sparse all-to-alls), jitted
+  dense grad step (ring all-reduce), sparse reduce-scatter of gradient
+  rows.  Per node: step wall, measured tx bytes, table exchange stats.
+- **serve** — the chief's sharded export (dense bundle + per-node shard
+  files) serves through a fresh 2-replica cluster: shards resident on the
+  replicas, the gateway's router fanning unique-id lookups over the
+  dedicated embed queue pair, then one wrapped scoring round.  Closed-loop
+  client threads measure sustained qps.
+
+Usage::
+
+    python bench_embedding.py                    # full run, markdown + JSON
+    python bench_embedding.py --smoke            # tiny config (CI smoke)
+    python bench_embedding.py --json BENCH_r19.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def _train_node(args, ctx):
+    """Node body: the sharded wide-and-deep sync-training loop, timed.
+
+    Publishes per-node wall time, the table's exchange stats, and the
+    MEASURED collective tx bytes (CSR frames + dense grad ring, everything
+    that rode the wire) via ``update_meta``.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import telemetry
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.embedding import (
+        EmbeddingShard,
+        ShardedTable,
+        ShardPlan,
+    )
+    from tensorflowonspark_tpu.embedding.serve import (
+        export_sharded_shard,
+        sharded_config_block,
+    )
+    from tensorflowonspark_tpu.models import wide_deep
+
+    config = dict(args["model_config"])
+    steps = int(args["steps"])
+    bsz = int(args["batch_size"])
+    lr = 0.125
+    group = ctx.collective_group(name="bench_embed")
+    group.form()
+    dim = int(config["embed_dim"]) + 1
+    plan = ShardPlan.even("wide_deep", wide_deep.table_total_rows(config),
+                          dim, group.world)
+    shard = EmbeddingShard.create(plan, group.rank, seed=11,
+                                  zero_cols=(dim - 1,))
+    table = ShardedTable(shard, group)
+    model = wide_deep.build_wide_deep_dense(config)
+    params = wide_deep.init_dense_params(model, jax.random.PRNGKey(0))
+    grad_fn = wide_deep.make_sharded_grad_fn(model)
+    optimizer = optax.sgd(lr)
+    opt_state = optimizer.init(params)
+    dense_reduce = group.grad_fn()
+    vocab = int(config["vocab_size"])
+
+    def one_step(step):
+        rows_src = wide_deep.synthetic_criteo(
+            bsz, seed=group.rank * 10007 + step)
+        batch = wide_deep.batch_to_arrays(rows_src)
+        ids = wide_deep.flat_categorical_ids(batch["features"], vocab)
+        rows = table.lookup(ids)
+        nonlocal params, opt_state
+        (_loss, _aux), (dg, rg) = grad_fn(params, rows, batch)
+        dg = dense_reduce(dg)
+        updates, opt_state = optimizer.update(dg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        table.apply_gradients(ids, np.asarray(jax.device_get(rg)), lr=lr,
+                              scale=1.0 / group.world)
+
+    one_step(0)  # warmup: jit compile + first exchanges, untimed
+    group.barrier()
+    tx0 = telemetry.counter("collective.tx_bytes").value()
+    t0 = time.monotonic()
+    for step in range(1, steps + 1):
+        one_step(step)
+    group.barrier()
+    wall = time.monotonic() - t0
+    tx = telemetry.counter("collective.tx_bytes").value() - tx0
+    if args.get("export_dir"):
+        export_sharded_shard(args["export_dir"], plan, group.rank,
+                             shard.rows, steps)
+        group.barrier()
+        if group.rank == 0:
+            export_bundle(args["export_dir"], jax.device_get(params),
+                          {**config, "sharded_embedding":
+                           sharded_config_block(plan, steps)})
+        ctx.barrier("export")
+    ctx.update_meta({"bench": {
+        "rank": group.rank, "world": group.world, "wall_secs": wall,
+        "tx_bytes": int(tx), "stats": dict(table.stats),
+        "table_rows": plan.total_rows, "dim": dim,
+    }})
+    group.close()
+
+
+def bench_train(model_config: dict, steps: int, batch_size: int,
+                world: int = 2, export_dir: str | None = None,
+                log_dir: str | None = None) -> dict:
+    """Run the W-node sharded training phase; returns the train metrics
+    plus the sparse-vs-dense exchanged-bytes comparison."""
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu.launcher import SubprocessLauncher
+
+    cluster = tcluster.run(
+        _train_node,
+        {"model_config": model_config, "steps": steps,
+         "batch_size": batch_size, "export_dir": export_dir},
+        num_executors=world, input_mode=tcluster.InputMode.STREAMING,
+        launcher=SubprocessLauncher(), log_dir=log_dir or "",
+        reservation_timeout=120.0)
+    cluster.shutdown(timeout=600.0)
+    metas = [m.get("bench") for m in cluster.coordinator.cluster_info()]
+    assert all(m is not None for m in metas), metas
+    wall = max(m["wall_secs"] for m in metas)
+    total_rows = metas[0]["table_rows"]
+    dim = metas[0]["dim"]
+    table_bytes = total_rows * dim * 4
+    # the dense alternative: replicate the table, ring-all-reduce its full
+    # gradient every step — 2(W-1)/W x table bytes per node per step
+    dense_alt = int(steps * 2 * (world - 1) / world * table_bytes)
+    sparse_measured = max(m["tx_bytes"] for m in metas)
+    return {
+        "world": world, "steps": steps, "batch_size": batch_size,
+        "vocab_size": model_config["vocab_size"],
+        "embed_dim": model_config["embed_dim"],
+        "table_rows": total_rows, "table_mb": round(table_bytes / 2**20, 1),
+        "train_rows_per_s": round(steps * batch_size * world / wall, 1),
+        "step_ms": round(1e3 * wall / steps, 1),
+        "sparse_tx_bytes_per_node": sparse_measured,
+        "dense_alt_bytes_per_node": dense_alt,
+        "dense_vs_sparse_x": round(dense_alt / max(1, sparse_measured), 1),
+        "stats": metas[0]["stats"],
+    }
+
+
+def bench_serve(export_dir: str, requests: int, rows_per_request: int,
+                clients: int = 4, log_dir: str | None = None) -> dict:
+    """Serve the sharded export through the gateway; closed-loop client
+    threads measure sustained qps + row throughput."""
+    from tensorflowonspark_tpu import cluster as tcluster
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.launcher import SubprocessLauncher
+    from tensorflowonspark_tpu.models import wide_deep
+
+    cluster = tcluster.run(
+        serving.serving_loop, {"export_dir": export_dir, "max_batch": 16},
+        num_executors=2, input_mode=tcluster.InputMode.STREAMING,
+        queues=("input", "output", "error", "embed", "embed_out"),
+        launcher=SubprocessLauncher(), log_dir=log_dir or "",
+        heartbeat_interval=0.5, reservation_timeout=120.0)
+    try:
+        gw = cluster.serve(export_dir, max_batch=16, max_delay_ms=2.0,
+                           reload_poll_secs=0)
+        pool = [np.asarray(r["features"], np.float32)
+                for r in wide_deep.synthetic_criteo(64, seed=77)]
+        gw.predict(pool[:rows_per_request], timeout=120.0)  # warmup
+        done = [0] * clients
+        errors = []
+
+        def client(ci):
+            for i in range(requests // clients):
+                rows = [pool[(ci + i + k) % len(pool)]
+                        for k in range(rows_per_request)]
+                try:
+                    out = gw.predict(rows, timeout=120.0)
+                    assert len(out) == rows_per_request
+                    done[ci] += 1
+                except Exception as e:  # noqa: BLE001 - recorded, re-raised
+                    errors.append(repr(e))
+                    return
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        assert not errors, errors
+        n = sum(done)
+        return {"serve_qps": round(n / wall, 1),
+                "serve_rows_per_s": round(n * rows_per_request / wall, 1),
+                "requests": n, "rows_per_request": rows_per_request,
+                "clients": clients}
+    finally:
+        cluster.shutdown(timeout=300.0)
+
+
+def bench(smoke: bool = False, world: int = 2) -> dict:
+    """Full bench: train phase + serve phase over the train export."""
+    if smoke:
+        model_config = {"model": "wide_deep_dense", "vocab_size": 1009,
+                        "embed_dim": 4, "hidden": (16, 8), "bf16": False}
+        steps, batch, requests, rows_per_req = 3, 16, 12, 4
+    else:
+        model_config = {"model": "wide_deep_dense", "vocab_size": 100_003,
+                        "embed_dim": 16, "hidden": (256, 128, 64),
+                        "bf16": False}
+        steps, batch, requests, rows_per_req = 10, 256, 120, 4
+    with tempfile.TemporaryDirectory() as tmp:
+        export = os.path.join(tmp, "export")
+        results = {"scenario": "r19", "smoke": smoke}
+        results["train"] = bench_train(model_config, steps, batch,
+                                       world=world, export_dir=export,
+                                       log_dir=tmp)
+        results["serve"] = bench_serve(export, requests, rows_per_req,
+                                       log_dir=tmp)
+    return results
+
+
+def markdown_table(results: dict) -> str:
+    t, s = results["train"], results["serve"]
+    lines = [
+        "| metric | value |",
+        "|---|---|",
+        f"| table ({t['table_rows']} rows x {t['embed_dim']}+1 cols) "
+        f"| {t['table_mb']} MB |",
+        f"| train rows/s (W={t['world']}, batch {t['batch_size']}) "
+        f"| {t['train_rows_per_s']} |",
+        f"| step wall | {t['step_ms']} ms |",
+        f"| sparse wire bytes/node ({t['steps']} steps) "
+        f"| {t['sparse_tx_bytes_per_node']} |",
+        f"| dense-replication alternative bytes/node "
+        f"| {t['dense_alt_bytes_per_node']} |",
+        f"| **dense vs sparse exchanged-bytes** "
+        f"| **{t['dense_vs_sparse_x']}x** |",
+        f"| serve qps ({s['rows_per_request']} rows/req, "
+        f"{s['clients']} clients) | {s['serve_qps']} |",
+        f"| serve rows/s | {s['serve_rows_per_s']} |",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", "--quick", action="store_true", dest="smoke",
+                    help="tiny config (CI smoke)")
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--json", default=None, help="write results JSON here")
+    args = ap.parse_args(argv)
+    results = bench(smoke=args.smoke, world=args.world)
+    print(markdown_table(results))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -235,6 +235,11 @@ class DialDisciplineChecker(Checker):
                        "collective/transport.py — it owns generation "
                        "stamping and the broken-connection abort cascade; "
                        "group.py/ops.py must go through PeerTransport")
+    embed_hint = ("the embedding tier has no wire of its own: shard "
+                  "exchanges ride the CollectiveGroup sparse ops and "
+                  "serving lookups ride the embed data-feed queue pair — "
+                  "a raw socket there would bypass generation fencing "
+                  "and the authkey handshake")
     ingest_hint = ("ingest-worker peer channels ride dataserver."
                    "DataClient/DataServer (the transport homes): the "
                    "authkey handshake, wire framing, and the forwarder's "
@@ -248,6 +253,7 @@ class DialDisciplineChecker(Checker):
         collective_confined = ("/collective/" in mod.path
                                and not mod.path.endswith(_COLLECTIVE_TRANSPORT))
         ingest_confined = "/ingest/" in mod.path
+        embed_confined = "/embedding/" in mod.path
         for node, scope in _scoped_walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -262,6 +268,17 @@ class DialDisciplineChecker(Checker):
                         "collective/transport.py bypasses the transport's "
                         "generation fencing and abort cascade",
                         self.collective_hint, f"{_qual(scope)}@{name}")
+                    continue
+            if embed_confined:
+                name = (fq.rsplit(".", 1)[-1] if fq
+                        else _terminal_name(node.func))
+                if name in _COLLECTIVE_SOCKET_CALLS:
+                    yield Finding(
+                        self.id, mod.path, node.lineno,
+                        f"raw socket ({name}()) in embedding/ — the tier "
+                        "rides the collective transport and the embed "
+                        "queue pair, never its own connections",
+                        self.embed_hint, f"{_qual(scope)}@{name}")
                     continue
             if ingest_confined:
                 name = (fq.rsplit(".", 1)[-1] if fq
@@ -482,6 +499,11 @@ _THREADED_BASENAMES = frozenset({
     # user stop()/report() calls, and the governor (policy.py) is mutated
     # from whatever thread drives decide()
     "loop.py", "policy.py",
+    # the sharded-embedding tier: the serving replica's responder thread
+    # reads shard rows the reload handler swaps (serve.py), and the table/
+    # shard state (table.py, sharding.py) is shared between the train-step
+    # thread and checkpoint/restore paths
+    "serve.py", "table.py", "sharding.py",
 })
 _BLOCKING_NAMES = frozenset({
     "recv", "accept", "join", "sleep", "connect_with_backoff",

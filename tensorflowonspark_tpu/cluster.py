@@ -881,7 +881,8 @@ class TPUCluster:
               shuffle_seed: int | None = None,
               num_partitions: int | None = None,
               span_bytes: int | None = None,
-              mode: str = "async") -> None:
+              mode: str = "async",
+              embedding: Any = None) -> None:
         """Feed the workers for ``num_epochs`` epochs; blocks until all
         partitions are consumed (or nodes report 'terminating').
 
@@ -946,6 +947,18 @@ class TPUCluster:
         # the collectives caveat on resize().
         sync_block = ({"group": "train", "world": len(self._feedable_ids())}
                       if mode == "sync" else None)
+        if embedding is not None:
+            # sharded-embedding declaration (ShardPlan or its manifest
+            # dict): published under the sync block so every node builds
+            # the SAME range-shard layout — the plan is the one authority
+            # on row ownership for the sparse collectives
+            if sync_block is None:
+                raise ValueError(
+                    "embedding plans require mode='sync' (the sharded "
+                    "table rides the sync collective group)")
+            sync_block["embedding"] = (embedding.to_manifest()
+                                       if hasattr(embedding, "to_manifest")
+                                       else dict(embedding))
         if self.input_mode == InputMode.DIRECT:
             from tensorflowonspark_tpu.ingest import shards_as_partitioned
 

@@ -2,13 +2,18 @@
 
 The subsystem behind ``cluster.train(..., mode="sync")``: coordinator-
 brokered group formation with generation fencing (``group.py``), ring /
-naive collective algorithms on numpy arrays (``ops.py``), and the peer
-transport that rides each node's existing zero-copy data-plane port
-(``transport.py``).  See the README "Synchronous training" section for
-the map_fun-level walkthrough.
+naive dense collective algorithms plus the sparse (CSR) all-to-all /
+reduce-scatter of the embedding tier on numpy arrays (``ops.py``), and the
+peer transport that rides each node's existing zero-copy data-plane port
+(``transport.py``).  See the README "Synchronous training" and "Sharded
+embeddings" sections for the map_fun-level walkthroughs.
 """
 
 from tensorflowonspark_tpu.collective.group import CollectiveGroup
-from tensorflowonspark_tpu.collective.transport import CollectiveAborted
+from tensorflowonspark_tpu.collective.transport import (
+    CollectiveAborted,
+    pack_csr,
+    unpack_csr,
+)
 
-__all__ = ["CollectiveAborted", "CollectiveGroup"]
+__all__ = ["CollectiveAborted", "CollectiveGroup", "pack_csr", "unpack_csr"]

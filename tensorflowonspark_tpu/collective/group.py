@@ -463,6 +463,25 @@ class CollectiveGroup:
                          lambda: cops.broadcast(self._tp, arr, seq=seq,
                                                 root=root, bucket_bytes=bb))
 
+    def sparse_all_to_all(self, parts: list) -> list:
+        """Personalized exchange of per-destination ``(ids, values)`` CSR
+        pairs (the embedding tier's lookup request/response legs); returns
+        the received pairs indexed by source rank.  Same comm thread, same
+        generation fencing, same abort cascade as the dense ops."""
+        seq = self._next_seq()
+        return self._run("sparse_all_to_all", seq,
+                         lambda: cops.sparse_all_to_all(self._tp, parts,
+                                                        seq=seq))
+
+    def sparse_reduce_scatter(self, ids, rows, bounds) -> tuple:
+        """Scatter (ids, rows) gradient contributions back to the ranks
+        owning them under the shard plan's ``bounds``; returns this rank's
+        exact-summed ``(uniq_ids, rows)`` — see ``ops.sparse_reduce_scatter``."""
+        seq = self._next_seq()
+        return self._run("sparse_reduce_scatter", seq,
+                         lambda: cops.sparse_reduce_scatter(
+                             self._tp, ids, rows, bounds, seq=seq))
+
     def barrier(self, timeout: float | None = None) -> None:
         """Control-plane barrier scoped to this group's EFFECTIVE world
         (generation-stamped name, so a stale member can never satisfy a

@@ -35,6 +35,8 @@ from tensorflowonspark_tpu import faultinject
 from tensorflowonspark_tpu.collective.transport import (
     CollectiveAborted,
     PeerTransport,
+    pack_csr,
+    unpack_csr,
 )
 
 
@@ -299,3 +301,132 @@ def all_reduce(tp: PeerTransport, arr: np.ndarray, *, seq: int,
         return naive_all_reduce(tp, arr, seq=seq, average=average)
     raise CollectiveAborted(f"unknown collective algorithm {algo!r} "
                             "(expected 'ring' or 'naive')")
+
+
+# -- sparse collectives (embedding tier) ---------------------------------------
+#
+# Model-parallel embedding tables exchange {row id -> row} SETS, not dense
+# segments: each step touches a batch-sized sliver of a table far too large
+# to all-reduce.  Both ops below are personalized exchanges over the same
+# generation-fenced wire as the dense ring — the large-message MPI
+# characterization regime (arxiv 1810.11112) where message COUNT is fixed
+# (W-1 pairwise frames) and bytes scale with touched rows, not table size.
+
+
+def sparse_all_to_all(tp: PeerTransport, parts: list, *,
+                      seq: int) -> list:
+    """Personalized all-to-all of per-destination (ids, values) CSR pairs.
+
+    ``parts`` is a world-length list: ``parts[d]`` is the ``(ids, values)``
+    pair bound for rank ``d`` (``values`` may be ``None`` for id-only lookup
+    requests; ids may be empty — the empty-partition edge ships a zero-row
+    frame so sender and receiver always agree on the message count).
+    Returns a world-length list indexed by SOURCE rank of ``(ids, values)``
+    received; the local part comes back as-is (no self-send).
+
+    Schedule: round ``off`` pairs rank with ``rank+off`` (send) and
+    ``rank-off`` (recv) — a fixed permutation schedule, so duplicate-free
+    progress needs no global coordination and determinism is inherited by
+    everything built on top.
+    """
+    world, rank = tp.world, tp.rank
+    if len(parts) != world:
+        raise CollectiveAborted(
+            f"sparse_all_to_all needs one part per rank: got {len(parts)} "
+            f"parts at world {world}")
+    out: list = [None] * world
+    ids0, vals0 = parts[rank] if isinstance(parts[rank], tuple) else (parts[rank], None)
+    out[rank] = unpack_csr(pack_csr(ids0, vals0))
+    if world <= 1:
+        faultinject.collective_round()
+        return out
+    deadline = _op_deadline(tp)
+    for off in range(1, world):
+        dst = (rank + off) % world
+        src = (rank - off) % world
+        ids, vals = parts[dst] if isinstance(parts[dst], tuple) else (parts[dst], None)
+        tp.send(dst, seq, ("sa", off), pack_csr(ids, vals))
+        if off == 1:
+            # mid-exchange chaos seam: the first pairwise frames are on the
+            # wire, the rest of the permutation schedule is still ahead
+            faultinject.collective_round()
+        out[src] = unpack_csr(tp.recv(src, seq, ("sa", off),
+                                      timeout=_left(deadline)))
+    return out
+
+
+def combine_csr(ids_list: list, rows_list: list,
+                dim: int) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic exact-sum combine of CSR contributions: concatenate in
+    LIST ORDER, then unbuffered scatter-add (``np.add.at``) into the sorted
+    unique-id index space.
+
+    This is the ONE summation kernel of the sparse path — the distributed
+    reduce-scatter sums each owner's contributions through it in rank order,
+    and the single-process reference replays the same per-node contribution
+    lists through it, so the two trajectories are bit-for-bit equal (float
+    addition is order-sensitive; sharing the kernel pins the order).
+    """
+    kept_i = [np.asarray(i, dtype=np.int64).reshape(-1) for i in ids_list]
+    n = sum(i.size for i in kept_i)
+    if n == 0:
+        return (np.empty((0,), np.int64), np.empty((0, dim), np.float32))
+    kept_r = [np.asarray(r, np.float32).reshape(-1, dim)
+              for r in rows_list if r is not None and np.asarray(r).size]
+    ids_all = np.concatenate(kept_i) if len(kept_i) != 1 else kept_i[0]
+    rows_all = (np.concatenate(kept_r, axis=0) if len(kept_r) != 1
+                else kept_r[0])
+    if rows_all.shape[0] != ids_all.shape[0]:
+        raise CollectiveAborted(
+            f"CSR combine mismatch: {ids_all.shape[0]} ids vs "
+            f"{rows_all.shape[0]} rows")
+    uniq, inv = np.unique(ids_all, return_inverse=True)
+    acc = np.zeros((uniq.size, dim), np.float32)
+    np.add.at(acc, inv, rows_all)
+    return uniq, acc
+
+
+def sparse_reduce_scatter(tp: PeerTransport, ids: np.ndarray,
+                          rows: np.ndarray, bounds, *,
+                          seq: int) -> tuple[np.ndarray, np.ndarray]:
+    """Sparse reduce-scatter: every rank contributes (ids, rows); each row
+    gradient scatters back to the rank whose shard range (``bounds``, the
+    embedding plan's world+1 monotone id bounds) owns its id, where
+    duplicates — within one contributor and across contributors — are
+    EXACT-summed in rank order via :func:`combine_csr`.
+
+    Returns ``(uniq_ids, summed_rows)`` for this rank's own id range.
+    A rank with zero ids for some owner still ships the empty CSR frame
+    (message-count agreement, like the dense ring's empty segments).
+    """
+    world, rank = tp.world, tp.rank
+    ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+    rows = np.asarray(rows, np.float32)
+    if rows.ndim != 2:
+        raise CollectiveAborted(
+            f"sparse_reduce_scatter rows must be [n, dim], got shape "
+            f"{rows.shape} (pass np.empty((0, dim)) for an empty "
+            "contribution — dim must survive the empty edge)")
+    if rows.shape[0] != ids.size:
+        raise CollectiveAborted(
+            f"sparse_reduce_scatter got {ids.size} ids for "
+            f"{rows.shape[0]} rows")
+    dim = int(rows.shape[1])
+    bounds = np.asarray(bounds, dtype=np.int64)
+    if bounds.size != world + 1:
+        raise CollectiveAborted(
+            f"sparse_reduce_scatter bounds must have world+1={world + 1} "
+            f"entries, got {bounds.size}")
+    if ids.size and (ids.min() < bounds[0] or ids.max() >= bounds[-1]):
+        raise CollectiveAborted(
+            f"sparse ids outside the shard plan [{bounds[0]}, {bounds[-1]})")
+    # partition by owner: searchsorted over the interior bounds maps each id
+    # to the rank whose [bounds[r], bounds[r+1]) range holds it
+    owner = np.searchsorted(bounds[1:-1], ids, side="right")
+    parts = []
+    for dst in range(world):
+        take = np.flatnonzero(owner == dst)
+        parts.append((ids[take], rows[take]))
+    got = sparse_all_to_all(tp, parts, seq=seq)
+    # rank-order combine: got[] is already indexed by source rank
+    return combine_csr([g[0] for g in got], [g[1] for g in got], dim)

@@ -61,6 +61,57 @@ class CollectiveTimeout(CollectiveAborted):
     group close for mere slowness."""
 
 
+# -- sparse (CSR) payloads -----------------------------------------------------
+#
+# The sparse collectives (embedding tier) ship {row id -> value row} sets
+# instead of dense segments.  The wire layout is CSR-style: one int64 id
+# vector plus one contiguous values matrix (ids[i] owns values[i]), framed as
+# a single chunk payload whose two arrays BOTH ride as protocol-5 out-of-band
+# buffers — same zero-copy path as the dense ring, same generation fencing.
+
+
+def pack_csr(ids, values) -> tuple:
+    """(ids, values) -> one sparse chunk payload.
+
+    ``ids`` is any int array-like ([n] global row ids), ``values`` the
+    matching ``[n, dim]`` rows (``None`` for id-only frames — the lookup
+    REQUEST direction of the embedding exchange, which asks for rows it
+    does not yet have)."""
+    import numpy as np
+
+    ids = np.ascontiguousarray(np.asarray(ids, dtype=np.int64).reshape(-1))
+    if values is None:
+        return ("csr", ids, None)
+    values = np.ascontiguousarray(np.asarray(values))
+    if values.ndim != 2 or values.shape[0] != ids.shape[0]:
+        raise ValueError(
+            f"CSR payload shape mismatch: {ids.shape[0]} ids vs values "
+            f"{values.shape}")
+    return ("csr", ids, values)
+
+
+def unpack_csr(payload) -> tuple:
+    """One sparse chunk payload -> (ids, values) (``values`` may be None)."""
+    if not (isinstance(payload, tuple) and len(payload) == 3
+            and payload[0] == "csr"):
+        raise CollectiveAborted(
+            f"expected a CSR sparse chunk, got {type(payload).__name__}")
+    return payload[1], payload[2]
+
+
+def payload_nbytes(payload) -> int:
+    """Wire-metering size of a chunk payload: dense arrays meter their own
+    ``nbytes``; CSR tuples meter ids + values (the bytes the sparse-vs-dense
+    bench headline compares).  Headers and other picklable odds and ends
+    meter 0 — metering exists for the tensor plane, not control chatter."""
+    n = getattr(payload, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(payload, tuple):
+        return sum(int(getattr(p, "nbytes", 0) or 0) for p in payload)
+    return 0
+
+
 # -- inbox registry (the dataserver's attach handler looks groups up here) ----
 
 _registry_lock = tos_named_lock("transport._registry_lock")
@@ -274,8 +325,7 @@ def serve_attached(conn: socket.socket, name: str, src_rank: int,
                 return
             _, gen, src, seq, tag, payload = msg
             last_gen = max(last_gen, int(gen))
-            nbytes = getattr(payload, "nbytes", 0)
-            rx_bytes.inc(int(nbytes))
+            rx_bytes.inc(payload_nbytes(payload))
             rx_frames.inc()
             inbox.deliver(int(gen), int(src), int(seq), tag, payload)
     except (ConnectionError, OSError, EOFError):
@@ -478,8 +528,7 @@ class PeerTransport:
                 sock.close()
             raise CollectiveAborted(
                 f"send to peer rank {dst} failed mid-round: {e}") from e
-        telemetry.counter("collective.tx_bytes").inc(
-            int(getattr(payload, "nbytes", 0)))
+        telemetry.counter("collective.tx_bytes").inc(payload_nbytes(payload))
         telemetry.counter("collective.tx_frames").inc()
 
     def _note_wait(self, wait: float) -> None:

@@ -9,6 +9,18 @@ feature-column hash buckets).  The wide path is a linear model over the
 one-hot categorical space implemented as embedding-gathers (a [B,26]
 gather, not a [B, vocab] one-hot matmul — HBM-friendly); the deep path is
 embeddings + MLP, whose matmuls ride the MXU in bf16.
+
+Memory math — why ``vocab_size`` MUST be plumbed, not defaulted: the table
+row count is ``26 * vocab_size``, so the default ``vocab_size=100_003``
+allocates ``2,600,078 x 16`` float32 embeddings (~166 MB) plus the wide
+column (~10 MB), and Adam's two moment slots triple that to ~530 MB —
+before a single batch.  A test that builds the default config to score ten
+rows pays all of it.  Every entry point therefore takes ``vocab_size``
+from the model config (``HasModelConfig`` in the pipeline layer carries it
+from Params to the map_fun); tests use a small prime like 1009 (~1.7 MB of
+tables).  Above one host's memory the answer is :class:`WideDeepDense` +
+the sharded embedding tier (``tensorflowonspark_tpu/embedding/``): the
+fused table lives OUTSIDE the flax params, range-sharded across nodes.
 """
 
 from __future__ import annotations
@@ -70,6 +82,94 @@ def build_wide_deep(config: dict) -> WideDeep:
         hidden=tuple(config.get("hidden", (256, 128, 64))),
         compute_dtype=jnp.bfloat16 if config.get("bf16", True) else jnp.float32,
     )
+
+
+class WideDeepDense(nn.Module):
+    """The DENSE half of wide-and-deep: everything except the tables.
+
+    The fused embedding table (one row per flat categorical id, laid out
+    ``[embed_dim deep floats | 1 wide weight]``) lives outside the flax
+    params in the sharded embedding tier; this module consumes the rows a
+    :class:`~tensorflowonspark_tpu.embedding.ShardedTable` lookup already
+    gathered.  The math mirrors :class:`WideDeep` term for term (same
+    reduction and dtype-cast order), and the param NAMES match
+    (``wide_numeric`` / ``Dense_i`` / ``deep_head``) so flax's path-based
+    RNG folds give the dense weights the same init streams.
+    """
+
+    vocab_size: int = 100_003  # for id-space checks + export config only
+    embed_dim: int = 16
+    hidden: Sequence[int] = (256, 128, 64)
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, rows):
+        """x: [B, 39] raw features; rows: [B, 26, embed_dim + 1] gathered
+        fused-table rows (last column = wide weight)."""
+        numeric = x[:, :NUM_NUMERIC].astype(self.compute_dtype)
+        wide = jnp.sum(rows[..., -1].astype(jnp.float32), axis=1,
+                       keepdims=True)
+        wide = wide + nn.Dense(1, dtype=jnp.float32, name="wide_numeric")(
+            x[:, :NUM_NUMERIC])
+        emb = rows[..., :self.embed_dim]
+        deep = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1).astype(self.compute_dtype), numeric],
+            axis=-1)
+        for h in self.hidden:
+            deep = nn.relu(nn.Dense(h, dtype=self.compute_dtype)(deep))
+        deep = nn.Dense(1, dtype=jnp.float32, name="deep_head")(deep)
+        return (wide + deep)[:, 0]
+
+
+@register("wide_deep_dense")
+def build_wide_deep_dense(config: dict) -> WideDeepDense:
+    return WideDeepDense(
+        vocab_size=config.get("vocab_size", 100_003),
+        embed_dim=config.get("embed_dim", 16),
+        hidden=tuple(config.get("hidden", (256, 128, 64))),
+        compute_dtype=jnp.bfloat16 if config.get("bf16", True) else jnp.float32,
+    )
+
+
+def table_total_rows(config: dict) -> int:
+    """Fused-table row count for a wide_deep config (26 disjoint column
+    id spaces)."""
+    return NUM_CATEGORICAL * int(config.get("vocab_size", 100_003))
+
+
+def flat_categorical_ids(features: np.ndarray, vocab_size: int) -> np.ndarray:
+    """[B, 39] raw features -> [B, 26] int64 fused-table ids (same mod +
+    per-column offset the monolithic module applies in-graph)."""
+    cat = np.mod(features[:, NUM_NUMERIC:].astype(np.int64), vocab_size)
+    offsets = np.arange(NUM_CATEGORICAL, dtype=np.int64) * vocab_size
+    return cat + offsets[None, :]
+
+
+def init_dense_params(model: WideDeepDense, rng: jax.Array):
+    from tensorflowonspark_tpu.models.registry import jit_init
+
+    dummy_x = jnp.zeros((1, NUM_NUMERIC + NUM_CATEGORICAL), jnp.float32)
+    dummy_rows = jnp.zeros((1, NUM_CATEGORICAL, model.embed_dim + 1),
+                           jnp.float32)
+    return jit_init(model, rng, dummy_x, dummy_rows)["params"]
+
+
+def make_sharded_grad_fn(model: WideDeepDense):
+    """Jitted ``(params, rows, batch) -> ((loss, aux), (dense_g, row_g))``.
+
+    ``row_g`` is the gradient w.r.t. the gathered fused rows — per-POSITION
+    rows ([B, 26, D+1]); ``ShardedTable.apply_gradients`` dedups and
+    scatter-adds them back to the owning shards.
+    """
+
+    def loss_fn(params, rows, batch):
+        logits = model.apply({"params": params}, batch["features"], rows)
+        labels = batch["label"].astype(jnp.float32)
+        loss = jnp.mean(optax_sigmoid_bce(logits, labels))
+        preds = (logits > 0).astype(jnp.float32)
+        return loss, {"accuracy": jnp.mean((preds == labels).astype(jnp.float32))}
+
+    return jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True))
 
 
 def init_params(model: WideDeep, rng: jax.Array):

@@ -202,6 +202,27 @@ class HasJaxDistributed(Params):
                             "over the cluster (global mesh spanning nodes)")
 
 
+class HasModelConfig(Params):
+    model_config = Param("model_config", None,
+                         "model registry config dict passed through to the "
+                         "train_fn as args.model_config (e.g. {'model': "
+                         "'wide_deep', 'vocab_size': 1009}) — the plumbing "
+                         "that keeps test/serve table sizes off the "
+                         "~530 MB wide_deep defaults")
+
+
+class HasTrainMode(Params):
+    train_mode = Param("train_mode", "async",
+                       "cluster.train feeding mode: 'async' (independent "
+                       "drains) or 'sync' (lockstep epochs + sync manifest "
+                       "block for collective train_fns)")
+    embedding_plan = Param("embedding_plan", None,
+                           "sharded-embedding plan manifest (ShardPlan or "
+                           "its to_manifest() dict) published to the nodes "
+                           "via the sync manifest block; requires "
+                           "train_mode='sync'")
+
+
 class HasScoring(Params):
     scoring = Param("scoring", "task",
                     "transform execution mode: 'task' (every node holds the "
@@ -260,7 +281,8 @@ class TPUParams(HasBatchSize, HasEpochs, HasSteps, HasInputMapping,
                 HasOutputMapping, HasInputMode, HasMasterNode, HasNumExecutors,
                 HasModelDir, HasExportDir, HasTFRecordDir, HasTensorboard,
                 HasLogDir, HasReaders, HasFeedTimeout, HasReservationTimeout,
-                HasShuffleSeed, HasJaxDistributed, HasScoring):
+                HasShuffleSeed, HasJaxDistributed, HasScoring,
+                HasModelConfig, HasTrainMode):
     """All framework params in one mixin stack (reference ``TFParams``)."""
 
     def merge_args_params(self, tf_args: Any = None) -> Namespace:
@@ -366,7 +388,9 @@ class TPUEstimator(TPUParams):
         try:
             if input_mode == InputMode.STREAMING:
                 cluster.train(data, num_epochs=args.epochs,
-                              shuffle_seed=args.shuffle_seed)
+                              shuffle_seed=args.shuffle_seed,
+                              mode=args.get("train_mode", "async"),
+                              embedding=args.get("embedding_plan"))
             elif shard_spec is not None:
                 # DIRECT onto the ledger-driven ingest feed: shard (and
                 # sub-shard span) work items flow through the partition
@@ -374,7 +398,9 @@ class TPUEstimator(TPUParams):
                 # re-feed and elastic recovery instead of staying
                 # self-service
                 cluster.train(shard_spec, num_epochs=args.epochs,
-                              shuffle_seed=args.shuffle_seed)
+                              shuffle_seed=args.shuffle_seed,
+                              mode=args.get("train_mode", "async"),
+                              embedding=args.get("embedding_plan"))
         finally:
             try:
                 cluster.shutdown()

@@ -139,6 +139,10 @@ class ServingGateway:
             capacity_fn=self._router.has_capacity,
             tenant_weights=tenant_weights)
         self._router._batcher = self._batcher
+        # sharded-embedding bundles: the config's "sharded_embedding" block
+        # puts the router into fan-out mode (gather each batch's fused-
+        # table rows from the replica shards before scoring)
+        self._refresh_embed_plan()
         # version watch: swap in a newer export, draining in-flight first
         self._export_sig = self._export_signature()
         self._watch_stop = threading.Event()
@@ -253,6 +257,7 @@ class ServingGateway:
                 acks = self._router.broadcast_ctl(ctl)
                 self._quarantine_laggards(
                     acks, bundle_signature(self.export_dir), ctl)
+                self._refresh_embed_plan()
                 telemetry.counter("serve.reloads_total").inc()
                 ttrace.event("reload", export_dir=self.export_dir,
                              replicas=sorted(acks))
@@ -279,6 +284,31 @@ class ServingGateway:
                            "recovery converges it", eid)
             self._router.quarantine_for_reload(eid, ctl)
         return laggards
+
+    def _refresh_embed_plan(self) -> None:
+        """Read the active export's bundle config and (re)arm the router's
+        sharded-embedding fan-out when it carries a ``sharded_embedding``
+        block — called at construction and after every fleet-wide reload
+        (a newer export may have moved the table's final step or
+        geometry).  Never raises: a malformed block degrades to plain
+        dense routing with a warning."""
+        import json
+        import os
+
+        from tensorflowonspark_tpu.utils.paths import resolve_uri
+
+        try:
+            with open(os.path.join(resolve_uri(self.export_dir),
+                                   "bundle.json")) as f:
+                config = json.load(f)
+            block = config.get("sharded_embedding")
+            if block:
+                from tensorflowonspark_tpu.embedding.serve import make_id_fn
+
+                self._router.set_embed_plan(block, make_id_fn(config))
+        except Exception:  # noqa: BLE001 - degrade to dense routing
+            logger.warning("could not arm sharded-embedding routing from "
+                           "%s", self.export_dir, exc_info=True)
 
     def _export_signature(self) -> tuple:
         """Change signature of the active export (see

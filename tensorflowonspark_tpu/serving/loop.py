@@ -65,6 +65,30 @@ def serving_loop(args, ctx) -> None:
     input_mapping = _arg(args, "input_mapping")
 
     variables, _config, apply_fn = load_bundle_cached(export_dir, build_apply)
+    # sharded-embedding bundles (config block written by the sharded
+    # export): load THIS replica's range of the table — re-sharded over the
+    # serve world — plus the dense-half apply, and answer the router's
+    # lookup fan-out on the dedicated embed queue pair from a responder
+    # thread.  Scoring batches then arrive as one-item `sharded_batch`
+    # control rounds carrying the rows the router already gathered.
+    embed_shard = None
+    sharded_apply = None
+    if _config.get("sharded_embedding"):
+        import threading
+
+        from tensorflowonspark_tpu.embedding.serve import (
+            build_sharded_apply,
+            embed_responder_loop,
+            load_serving_shard,
+        )
+
+        _, embed_shard = load_serving_shard(
+            export_dir, _config["sharded_embedding"], ctx.executor_id,
+            ctx.num_executors)
+        sharded_apply = build_sharded_apply(_config)
+        threading.Thread(
+            target=embed_responder_loop, args=(ctx, embed_shard),
+            daemon=True, name=f"embed-responder-{ctx.executor_id}").start()
     # staged-rollout state: True while this replica serves a rollout
     # CANDIDATE bundle (set by the reload ctl's `candidate` bit) — the
     # bad_model chaos hook only ever corrupts candidate output
@@ -78,6 +102,24 @@ def serving_loop(args, ctx) -> None:
             continue
         if len(items) == 1 and isinstance(items[0], dict) and CTL_KEY in items[0]:
             op = items[0][CTL_KEY]
+            if op == "sharded_batch":
+                # one wrapped scoring batch: raw rows + the fused-table rows
+                # the router's fan-out gathered; one result item back keeps
+                # the exactly-count invariant (the router unwraps it)
+                rows = items[0]["rows"]
+                emb = np.asarray(items[0]["emb"], np.float32)
+                with ctx.metrics.timed("serve.node_batch_secs"), \
+                        ttrace.span("serve.node_compute",
+                                    parent=getattr(feed, "last_trace", None)):
+                    x = rows_to_features(list(rows), input_mapping)
+                    out = np.asarray(sharded_apply(variables, x, emb))
+                results = ([int(p) for p in out.argmax(axis=-1)]
+                           if postprocess == "argmax" else list(out))
+                batches.inc()
+                rows_served.inc(len(rows))
+                feed.batch_results([{CTL_KEY: "sharded_results",
+                                     "results": results}], chunk=True)
+                continue
             if op == "reload":
                 # the ctl may redirect this replica to a DIFFERENT export
                 # (canary load / rollback); a plain reload re-reads the
@@ -87,6 +129,20 @@ def serving_loop(args, ctx) -> None:
                 invalidate_bundle(export_dir)
                 variables, _config, apply_fn = load_bundle_cached(
                     export_dir, build_apply)
+                if embed_shard is not None and _config.get("sharded_embedding"):
+                    # newer export: swap the resident range in place (the
+                    # responder thread reads shard.rows, so the swap is
+                    # visible to in-flight lookups atomically per request)
+                    from tensorflowonspark_tpu.embedding.serve import (
+                        build_sharded_apply,
+                        load_serving_shard,
+                    )
+
+                    _, fresh = load_serving_shard(
+                        export_dir, _config["sharded_embedding"],
+                        ctx.executor_id, ctx.num_executors)
+                    embed_shard.rows = fresh.rows
+                    sharded_apply = build_sharded_apply(_config)
                 ctx.metrics.counter("serve.node_reloads").inc()
                 # echo dir + on-disk signature: the gateway verifies every
                 # cohort member converged on the bundle it asked for

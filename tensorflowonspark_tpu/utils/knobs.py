@@ -99,6 +99,18 @@ _ALL = (
          "cluster.resize scale-in: budget for a victim to drain (serving "
          "in-flight + buffered partitions) and exit after EOF before the "
          "reaper escalates to terminate."),
+    Knob("TOS_EMBED_CKPT_EVERY", "int", "0 (disabled)",
+         "Sharded embedding tier: checkpoint each node's resident shard "
+         "range every N training steps (ShardedTable.maybe_checkpoint); "
+         "0 leaves durability to explicit checkpoint() calls."),
+    Knob("TOS_EMBED_DEDUP", "bool", "1",
+         "Sharded embedding tier: 1 dedups a batch's flat ids (np.unique) "
+         "before the lookup exchange so each unique row crosses the wire "
+         "once; 0 ships per-position ids verbatim (debug / tiny batches)."),
+    Knob("TOS_EMBED_LOOKUP_TIMEOUT", "float", "30",
+         "Serving-side sharded embeddings: budget (seconds) for one "
+         "fan-out lookup round against the replica shards before the "
+         "request errors."),
     Knob("TOS_EOF_TIMEOUT", "float", "20",
          "Budget (seconds) for the teardown-path EndOfFeed round-trip to "
          "each node."),

@@ -221,8 +221,13 @@ def train_wide_deep(args, ctx):
     from tensorflowonspark_tpu.parallel import mesh as meshlib
     import jax
 
-    config = {"model": "wide_deep", "vocab_size": args.get("vocab_size", 1009),
-              "embed_dim": 4, "hidden": (16, 8), "bf16": False}
+    # model_config (pipeline HasModelConfig param) wins; vocab_size rides
+    # as a bare test knob otherwise.  Never fall back to the module default
+    # vocab — that is the ~530 MB monolithic-table footgun.
+    config = dict(args.get("model_config") or
+                  {"model": "wide_deep",
+                   "vocab_size": args.get("vocab_size", 1009),
+                   "embed_dim": 4, "hidden": (16, 8), "bf16": False})
     model = wide_deep.build_wide_deep(config)
     params = wide_deep.init_params(model, jax.random.PRNGKey(0))
     optimizer = optax.adam(1e-2)
@@ -883,5 +888,409 @@ def sync_collective_chaos(args, ctx):
         "generation": group.generation, "incarnation": ctx.incarnation,
         "final_w": np.asarray(
             jax.device_get(state.params["w"])).ravel().tolist(),
+    }})
+    group.close()
+
+
+# -- sharded embeddings (ISSUE 19) --------------------------------------------
+
+
+def tree_digest(tree) -> str:
+    """Order-pinned sha256 of a params pytree (flattened, keys sorted) —
+    the bit-for-bit comparison handle the sharded-vs-unsharded parity
+    tests exchange through update_meta instead of whole tables."""
+    import hashlib
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.checkpoint import _flatten_tree
+
+    h = hashlib.sha256()
+    flat = _flatten_tree(tree)
+    for key in sorted(flat):
+        h.update(key.encode())
+        arr = np.ascontiguousarray(np.asarray(flat[key]))
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def criteo_batch(rank, step, batch_size=8):
+    """Deterministic per-(rank, step) synthetic-Criteo batch, so sharded
+    parity/chaos references can replay the exact per-node schedule."""
+    from tensorflowonspark_tpu.models import wide_deep
+
+    rows = wide_deep.synthetic_criteo(batch_size, seed=rank * 10007 + step)
+    return wide_deep.batch_to_arrays(rows)
+
+
+def embedding_probe(args, ctx):
+    """Sparse-collective probe: exact-sum with duplicate ids within AND
+    across nodes, the empty-partition edge (one owner receives nothing),
+    a sparse all-to-all echo, and dense/sparse parity on a small table.
+    Publishes everything for driver-side equality checks."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.embedding import ShardPlan
+
+    group = ctx.collective_group(name="embprobe")
+    group.form()
+    r, w = group.rank, group.world
+    plan = ShardPlan.even("probe", 40, 3, w)
+
+    # all-to-all echo: rank r sends [r*100 + d] to each d
+    parts = [(np.array([r * 100 + d], np.int64), None) for d in range(w)]
+    echo = group.sparse_all_to_all(parts)
+    echo_ids = [g[0].tolist() for g in echo]
+
+    # exact-sum: duplicate id 1 within each node and across all nodes,
+    # plus a per-rank id — integer-valued floats, so sums are exact
+    ids = np.array([1, 1, 30 + r, 7], np.int64)
+    rows = np.full((4, 3), float(r + 1), np.float32)
+    got_ids, got_rows = group.sparse_reduce_scatter(ids, rows, plan.bounds)
+
+    # dense parity: the same contribution as a dense [total, dim] gradient
+    # all-reduced — the sparse result must match the dense sum row for row
+    dense = np.zeros((40, 3), np.float32)
+    np.add.at(dense, ids, rows)
+    dense_sum = group.all_reduce(dense)
+    lo, hi = plan.range_of(r)
+    mine = dense_sum[lo:hi]
+    sparse_full = np.zeros_like(mine)
+    if got_ids.size:
+        sparse_full[got_ids - lo] = got_rows
+    dense_match = bool(np.array_equal(sparse_full, mine))
+
+    # empty-partition edge: every id lands in rank 0's range, so all other
+    # owners must see a zero-row result (and nobody deadlocks on the empty
+    # frames)
+    ids0 = np.array([0, 2, 0], np.int64)
+    rows0 = np.full((3, 3), float(10 * (r + 1)), np.float32)
+    e_ids, e_rows = group.sparse_reduce_scatter(ids0, rows0, plan.bounds)
+    group.barrier()
+    ctx.update_meta({"embed_probe": {
+        "rank": r, "world": w,
+        "echo_ids": echo_ids,
+        "got_ids": got_ids.tolist(), "got_rows": got_rows.tolist(),
+        "dense_match": dense_match,
+        "empty_ids": e_ids.tolist(),
+        "empty_shape": list(e_rows.shape),
+    }})
+    group.close()
+
+
+def train_wide_deep_sharded(args, ctx):
+    """Sharded wide-and-deep sync training on deterministic synthetic-
+    Criteo batches: dense half replicated (ring-averaged grads), fused
+    embedding table range-sharded via the sparse collectives.  Publishes
+    bit-comparison digests; with ``args.export_dir`` set, exports a
+    sharded bundle (dense bundle + per-node shard ranges) for the serving
+    tier."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.embedding import (
+        EmbeddingShard,
+        ShardedTable,
+        ShardPlan,
+    )
+    from tensorflowonspark_tpu.embedding.serve import (
+        export_sharded_shard,
+        sharded_config_block,
+    )
+    from tensorflowonspark_tpu.models import wide_deep
+
+    config = dict(args.get("model_config") or
+                  {"model": "wide_deep_dense", "vocab_size": 97,
+                   "embed_dim": 4, "hidden": (8,), "bf16": False})
+    lr = float(args.get("lr", 0.125))  # power of two: exact at any world
+    total = int(args.get("steps", 4))
+    bsz = int(args.get("batch_size", 8))
+    seed = int(args.get("table_seed", 11))
+
+    group = ctx.collective_group(name="embed")
+    group.form()
+    block = (ctx.job_manifest().get("sync") or {}).get("embedding")
+    plan = (ShardPlan.from_manifest(block) if block else
+            ShardPlan.even("wide_deep", wide_deep.table_total_rows(config),
+                           int(config["embed_dim"]) + 1, group.world))
+    # fused table: [embed_dim | wide weight]; wide column zero-init like
+    # the monolithic model's wide_weights
+    shard = EmbeddingShard.create(plan, group.rank, seed=seed,
+                                  zero_cols=(plan.dim - 1,))
+    table = ShardedTable(shard, group)
+
+    model = wide_deep.build_wide_deep_dense(config)
+    params = wide_deep.init_dense_params(model, jax.random.PRNGKey(0))
+    grad_fn = wide_deep.make_sharded_grad_fn(model)
+    optimizer = optax.sgd(lr)
+    opt_state = optimizer.init(params)
+    dense_reduce = group.grad_fn()  # ring mean — exact at world 2
+    vocab = int(config["vocab_size"])
+
+    losses = []
+    for step in range(total):
+        batch = criteo_batch(group.rank, step, bsz)
+        ids = wide_deep.flat_categorical_ids(batch["features"], vocab)
+        rows = table.lookup(ids)
+        (loss, _aux), (dg, rg) = grad_fn(params, rows, batch)
+        dg = dense_reduce(dg)
+        updates, opt_state = optimizer.update(dg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        table.apply_gradients(ids, np.asarray(jax.device_get(rg)), lr=lr,
+                              scale=1.0 / group.world)
+        losses.append(float(loss))
+    group.barrier()
+    if args.get("export_dir"):
+        export_sharded_shard(args["export_dir"], plan, group.rank,
+                             shard.rows, total)
+        group.barrier()  # all shards committed before the chief's bundle
+        if group.rank == 0:
+            export_bundle(
+                args["export_dir"], jax.device_get(params),
+                {**config, "sharded_embedding":
+                 sharded_config_block(plan, total)})
+        ctx.barrier("export")
+    ctx.update_meta({"sharded_train": {
+        "rank": group.rank, "world": group.world, "steps": total,
+        "losses": losses,
+        "dense_digest": tree_digest(jax.device_get(params)),
+        "shard_digest": tree_digest({"rows": shard.rows}),
+        "shard_range": [shard.lo, shard.hi],
+        "stats": dict(table.stats),
+        "manifest_embedding": block,
+    }})
+    group.close()
+
+
+def sharded_embed_chaos(args, ctx):
+    """Sharded-table sync training surviving a SIGKILL of a shard OWNER
+    mid-step: nobody else holds the dead node's rows, so recovery is
+    checkpoint-based — every completed step commits the shard range + the
+    dense params, and after the generation reforms the members min-vote
+    their newest complete checkpoint, ALL restore to it (survivors roll
+    back), and the deterministic schedule replays.  Exact step accounting:
+    every node finishes at ``args['steps']`` with digests equal to the
+    fault-free reference."""
+    import glob
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import (
+        _flatten_tree,
+        _unflatten_tree,
+    )
+    from tensorflowonspark_tpu.collective import CollectiveAborted
+    from tensorflowonspark_tpu.embedding import (
+        EmbeddingShard,
+        ShardedTable,
+        ShardPlan,
+    )
+    from tensorflowonspark_tpu.models import wide_deep
+
+    config = dict(args.get("model_config") or
+                  {"model": "wide_deep_dense", "vocab_size": 53,
+                   "embed_dim": 3, "hidden": (8,), "bf16": False})
+    lr = 0.125
+    total = int(args["steps"])
+    bsz = int(args.get("batch_size", 8))
+    model_dir = args["model_dir"]
+    eid = ctx.executor_id
+
+    group = ctx.collective_group(name="embchaos", timeout=15.0)
+    group.form(resume_step=0)
+    plan = ShardPlan.even("chaos", wide_deep.table_total_rows(config),
+                          int(config["embed_dim"]) + 1, group.world)
+    shard = EmbeddingShard.create(plan, group.rank, seed=5,
+                                  zero_cols=(plan.dim - 1,))
+    table = ShardedTable(shard, group)
+
+    model = wide_deep.build_wide_deep_dense(config)
+    params = wide_deep.init_dense_params(model, jax.random.PRNGKey(0))
+    grad_fn = wide_deep.make_sharded_grad_fn(model)
+    optimizer = optax.sgd(lr)
+    opt_state = optimizer.init(params)
+    dense_reduce = group.grad_fn()
+    vocab = int(config["vocab_size"])
+
+    def dense_path(s):
+        return os.path.join(model_dir, f"dense_e{eid}_s{s}.npz")
+
+    def save_all(s):
+        shard.save(model_dir, s)
+        flat = {k: np.asarray(v)
+                for k, v in _flatten_tree(jax.device_get(params)).items()}
+        tmp = dense_path(s) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, dense_path(s))
+
+    def restore_all(s):
+        nonlocal params, opt_state
+        shard.restore(model_dir, s)
+        with np.load(dense_path(s)) as z:
+            params = _unflatten_tree({k: z[k] for k in z.files})
+        opt_state = optimizer.init(params)  # sgd: stateless, exact
+
+    def latest_saved():
+        best = -1
+        for path in glob.glob(dense_path("*")):
+            try:
+                s = int(path.rsplit("_s", 1)[1][:-len(".npz")])
+            except ValueError:
+                continue
+            shard_file = os.path.join(
+                model_dir, f"embed_{plan.name}", f"step_{s}",
+                f"shard_{shard.lo}_{shard.hi}.npz")
+            if os.path.exists(shard_file):
+                best = max(best, s)
+        return best
+
+    def rendezvous(reform):
+        """(Re)align the group, min-vote the newest complete checkpoint,
+        restore everyone to it.  Returns the agreed step."""
+        deadline = time.monotonic() + 240.0
+        while True:
+            try:
+                mine = latest_saved()
+                if reform:
+                    group.reform(resume_step=max(mine, 0))
+                votes = group.all_gather(
+                    np.array([mine], np.int64))
+                agreed = int(min(int(v[0]) for v in votes))
+                if agreed < 0:
+                    raise RuntimeError(
+                        "no complete checkpoint on some member")
+                restore_all(agreed)
+                return agreed
+            except (CollectiveAborted, RuntimeError, ConnectionError):
+                reform = True
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    if ctx.is_restart:
+        # the restarted victim: its in-memory table is fresh init — level
+        # everyone from checkpoints (survivors roll back to the min vote)
+        step = rendezvous(reform=False)
+    else:
+        save_all(0)
+        step = 0
+    reforms = 0
+    while step < total:
+        batch = criteo_batch(group.rank, step, bsz)
+        try:
+            ids = wide_deep.flat_categorical_ids(batch["features"], vocab)
+            rows = table.lookup(ids)  # victim's kill fires in here
+            (_loss, _aux), (dg, rg) = grad_fn(params, rows, batch)
+            dg = dense_reduce(dg)
+            updates, opt_state = optimizer.update(dg, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            table.apply_gradients(ids, np.asarray(jax.device_get(rg)),
+                                  lr=lr, scale=1.0 / group.world)
+        except CollectiveAborted:
+            step = rendezvous(reform=True)
+            reforms += 1
+            continue
+        step += 1
+        save_all(step)
+    while True:
+        try:
+            group.barrier(timeout=10.0)
+            break
+        except (CollectiveAborted, RuntimeError, ConnectionError):
+            step = rendezvous(reform=True)
+            reforms += 1
+    ctx.update_meta({"embed_chaos": {
+        "rank": group.rank, "steps": step, "reforms": reforms,
+        "generation": group.generation, "incarnation": ctx.incarnation,
+        "dense_digest": tree_digest(jax.device_get(params)),
+        "shard_digest": tree_digest({"rows": shard.rows}),
+    }})
+    group.close()
+
+
+def estimator_wide_deep_sharded(args, ctx):
+    """Feed-driven sharded train_fn for the TFEstimator path: synthetic-
+    Criteo rows stream through the ordinary ingest/feed tier in lockstep,
+    the fused table rides the sparse collectives, and the chief exports a
+    sharded bundle to ``args.export_dir``."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import export_bundle
+    from tensorflowonspark_tpu.embedding import (
+        EmbeddingShard,
+        ShardedTable,
+        ShardPlan,
+    )
+    from tensorflowonspark_tpu.embedding.serve import (
+        export_sharded_shard,
+        sharded_config_block,
+    )
+    from tensorflowonspark_tpu.models import wide_deep
+    from tensorflowonspark_tpu.parallel import dp as dplib
+
+    config = dict(args.get("model_config") or {})
+    if not config:
+        raise ValueError("estimator_wide_deep_sharded needs model_config")
+    lr = float(args.get("lr", 0.125))
+    vocab = int(config["vocab_size"])
+
+    group = ctx.collective_group(name="embed")
+    group.form()
+    block = (ctx.job_manifest().get("sync") or {}).get("embedding")
+    plan = (ShardPlan.from_manifest(block) if block else
+            ShardPlan.even("wide_deep", wide_deep.table_total_rows(config),
+                           int(config["embed_dim"]) + 1, group.world))
+    shard = EmbeddingShard.create(plan, group.rank, seed=11,
+                                  zero_cols=(plan.dim - 1,))
+    table = ShardedTable(shard, group)
+
+    model = wide_deep.build_wide_deep_dense(config)
+    params = wide_deep.init_dense_params(model, jax.random.PRNGKey(0))
+    grad_fn = wide_deep.make_sharded_grad_fn(model)
+    optimizer = optax.sgd(lr)
+    opt_state = optimizer.init(params)
+    dense_reduce = group.grad_fn()
+
+    feed = ctx.get_data_feed(train_mode=True)
+    n_steps = 0
+    loss = None
+    for batch, _n in dplib.make_batch_iterator(
+            feed, int(args.get("batch_size", 8)),
+            wide_deep.batch_to_arrays, ctx=ctx, lockstep=True,
+            max_steps=args.get("steps")):
+        ids = wide_deep.flat_categorical_ids(
+            np.asarray(batch["features"]), vocab)
+        rows = table.lookup(ids)
+        (loss_v, _aux), (dg, rg) = grad_fn(params, rows, batch)
+        dg = dense_reduce(dg)
+        updates, opt_state = optimizer.update(dg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        table.apply_gradients(ids, np.asarray(jax.device_get(rg)), lr=lr,
+                              scale=1.0 / group.world)
+        table.maybe_checkpoint(args.get("model_dir") or args.get("export_dir"),
+                               n_steps)
+        loss = float(loss_v)
+        n_steps += 1
+    group.barrier()
+    export_sharded_shard(args.get("export_dir"), plan, group.rank, shard.rows,
+                         n_steps)
+    group.barrier()
+    if group.rank == 0:
+        export_bundle(args.get("export_dir"), jax.device_get(params),
+                      {**config, "sharded_embedding":
+                       sharded_config_block(plan, n_steps)})
+    ctx.barrier("export")
+    ctx.update_meta({"sharded_train": {
+        "rank": group.rank, "world": group.world, "steps": n_steps,
+        "loss": loss, "stats": dict(table.stats),
+        "manifest_embedding": block,
     }})
     group.close()

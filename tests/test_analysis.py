@@ -243,6 +243,45 @@ def test_dial_quiet_on_ingest_dataclient_forwarding():
     assert lint(src, f"{PKG}/ingest/service.py", "dial-discipline") == []
 
 
+def test_dial_fires_on_embedding_tier_sockets():
+    """ISSUE 19 satellite: the embedding tier has no wire of its own —
+    raw sockets (even the sanctioned dial helpers) fire anywhere under
+    embedding/; exchanges must ride the collective transport or the embed
+    data-feed queue pair."""
+    found = lint(
+        """
+        import socket
+        from tensorflowonspark_tpu.utils.net import connect_with_backoff
+        def fetch_rows(addr):
+            c = connect_with_backoff(addr)
+            s = socket.socket()
+            return c, s
+        """, f"{PKG}/embedding/table.py", "dial-discipline")
+    assert {f.anchor for f in found} == {
+        "fetch_rows@connect_with_backoff", "fetch_rows@socket"}
+    assert all("embedding/" in f.message for f in found)
+
+
+def test_dial_quiet_on_embedding_collective_and_feed_use():
+    """The compliant shape: lookups ride group.sparse_all_to_all and the
+    responder rides ctx.get_data_feed — nothing under embedding/ fires."""
+    src = """
+        def exchange(group, parts, ctx):
+            got = group.sparse_all_to_all(parts)
+            feed = ctx.get_data_feed(train_mode=False, qname_in="embed")
+            return got, feed
+        """
+    assert lint(src, f"{PKG}/embedding/table.py", "dial-discipline") == []
+    assert lint(src, f"{PKG}/embedding/serve.py", "dial-discipline") == []
+
+
+def test_lock_discipline_covers_embedding_modules():
+    """The embedding tier's modules are in the threaded set: the classic
+    mixed locked/unlocked mutation fixture must fire there."""
+    found = lint(_MIXED, f"{PKG}/embedding/table.py", "lock-discipline")
+    assert any(f.anchor.endswith("n") for f in found), found
+
+
 # -- lock discipline ----------------------------------------------------------
 
 _MIXED = """

@@ -1,0 +1,35 @@
+"""Tier-1 smoke for the sharded-embedding bench (ISSUE 19 satellite):
+``bench_embedding.py --smoke`` must stay runnable as the tier evolves —
+train phase, sharded export, gateway serve phase, and the
+sparse-vs-dense bytes comparison all present and sane."""
+
+from __future__ import annotations
+
+import pytest
+
+
+def test_bench_embedding_smoke_runs():
+    import bench_embedding  # repo root is on sys.path via conftest
+
+    results = bench_embedding.bench(smoke=True)
+    t, s = results["train"], results["serve"]
+    assert t["world"] == 2 and t["steps"] == 3
+    assert t["train_rows_per_s"] > 0
+    assert s["serve_qps"] > 0 and s["requests"] > 0
+    # the headline: the sparse tier must exchange (far) fewer bytes than
+    # the dense table-replication alternative, and the CSR frames must
+    # actually have ridden the wire
+    assert t["sparse_tx_bytes_per_node"] > 0
+    assert t["dense_alt_bytes_per_node"] > t["sparse_tx_bytes_per_node"]
+    assert t["dense_vs_sparse_x"] > 1.0
+    assert t["stats"]["ids_sent"] > 0 and t["stats"]["grad_rows_sent"] > 0
+    table = bench_embedding.markdown_table(results)
+    assert "dense vs sparse" in table
+
+
+def test_bench_embedding_cli_help():
+    import bench_embedding
+
+    with pytest.raises(SystemExit) as e:
+        bench_embedding.main(["--help"])
+    assert e.value.code == 0
